@@ -1,0 +1,221 @@
+"""Data-layer unit tests: tf.Example codec, vocab, chunk IO, OOV mapping.
+
+The reference has no Python unit tests at all (SURVEY.md §4); these cover
+the exact-parity behaviors: special-token ids, OOV temp-id assignment,
+chunk wire format, abstract sentence splitting.
+"""
+
+import os
+import struct
+
+import pytest
+
+from textsummarization_on_flink_tpu.data import (
+    PAD_TOKEN,
+    START_DECODING,
+    STOP_DECODING,
+    TFExample,
+    UNKNOWN_TOKEN,
+    Vocab,
+    abstract2ids,
+    abstract2sents,
+    article2ids,
+    example_generator,
+    outputids2words,
+    read_chunk_file,
+    show_abs_oovs,
+    show_art_oovs,
+    write_chunk_file,
+)
+from textsummarization_on_flink_tpu.data.chunks import bin2txt, write_chunked
+
+
+def make_vocab(words=("the", "cat", "sat", "on", "mat")):
+    return Vocab(words=words)
+
+
+class TestTFExample:
+    def test_roundtrip_bytes(self):
+        ex = TFExample().set_bytes("article", b"hello world").set_bytes("uuid", b"u-1")
+        back = TFExample.parse(ex.serialize())
+        assert back.get_str("article") == "hello world"
+        assert back.get_str("uuid") == "u-1"
+
+    def test_roundtrip_floats_ints(self):
+        ex = TFExample().set_floats("f", 1.5, -2.25).set_ints("i", 7, -3, 1 << 40)
+        back = TFExample.parse(ex.serialize())
+        assert back.features["f"] == [1.5, -2.25]
+        assert back.features["i"] == [7, -3, 1 << 40]
+
+    def test_unicode(self):
+        ex = TFExample().set_bytes("a", "héllo wörld ✓")
+        assert TFExample.parse(ex.serialize()).get_str("a") == "héllo wörld ✓"
+
+    def test_tensorflow_wire_compat(self):
+        """Golden bytes produced by tf.train.Example for {"x": [b"ab"]}:
+        feature map entry key=1 string, value=2 Feature{bytes_list=1}."""
+        golden = bytes.fromhex("0a0d0a0b0a017812060a040a026162")
+        back = TFExample.parse(golden)
+        assert back.get_str("x") == "ab"
+        assert TFExample().set_bytes("x", b"ab").serialize() == golden
+
+
+class TestVocab:
+    def test_special_ids(self):
+        v = make_vocab()
+        assert v.word2id(UNKNOWN_TOKEN) == 0
+        assert v.word2id(PAD_TOKEN) == 1
+        assert v.word2id(START_DECODING) == 2
+        assert v.word2id(STOP_DECODING) == 3
+        assert v.word2id("the") == 4
+        assert v.size() == 9
+
+    def test_unk_for_oov(self):
+        v = make_vocab()
+        assert v.word2id("zebra") == 0
+        with pytest.raises(ValueError):
+            v.id2word(9999)
+
+    def test_file_loading_max_size_and_malformed(self, tmp_path):
+        p = tmp_path / "vocab"
+        p.write_text("the 100\ncat 50\nmalformed\nsat 10\non 5\n")
+        v = Vocab(str(p), max_size=6)  # 4 specials + 2 words
+        assert v.size() == 6
+        assert v.word2id("cat") == 5
+        assert v.word2id("sat") == 0  # cut off by max_size -> UNK
+
+    def test_forbidden_and_duplicate(self, tmp_path):
+        p = tmp_path / "vocab"
+        p.write_text("<s> 5\n")
+        with pytest.raises(ValueError):
+            Vocab(str(p))
+        p.write_text("cat 5\ncat 3\n")
+        with pytest.raises(ValueError):
+            Vocab(str(p))
+
+    def test_write_metadata(self, tmp_path):
+        v = make_vocab(("a", "b"))
+        f = tmp_path / "meta.tsv"
+        v.write_metadata(str(f))
+        assert f.read_text().splitlines() == [
+            "[UNK]", "[PAD]", "[START]", "[STOP]", "a", "b"]
+
+
+class TestOOV:
+    def test_article2ids(self):
+        v = make_vocab()
+        ids, oovs = article2ids("the cat zebra sat zebra yak".split(), v)
+        assert oovs == ["zebra", "yak"]
+        assert ids == [4, 5, v.size(), 6, v.size(), v.size() + 1]
+
+    def test_abstract2ids(self):
+        v = make_vocab()
+        _, oovs = article2ids("the zebra".split(), v)
+        ids = abstract2ids("the zebra emu".split(), v, oovs)
+        assert ids == [4, v.size(), 0]  # emu: out-of-article OOV -> UNK
+
+    def test_outputids2words_roundtrip(self):
+        v = make_vocab()
+        ids, oovs = article2ids("the cat zebra".split(), v)
+        assert outputids2words(ids, v, oovs) == ["the", "cat", "zebra"]
+        with pytest.raises(ValueError):
+            outputids2words([v.size() + 5], v, oovs)
+
+    def test_abstract2sents(self):
+        s = "<s> first sent . </s> <s> second . </s>"
+        assert abstract2sents(s) == [" first sent . ", " second . "]
+        assert abstract2sents("no tags here") == []
+
+    def test_show_oovs(self):
+        v = make_vocab()
+        assert show_art_oovs("the zebra sat", v) == "the __zebra__ sat"
+        out = show_abs_oovs("the zebra emu", v, ["zebra"])
+        assert out == "the __zebra__ !!__emu__!!"
+
+
+class TestChunks:
+    def _examples(self, n):
+        return [
+            TFExample().set_bytes("article", f"article {i}".encode())
+            .set_bytes("abstract", f"<s> abstract {i} . </s>".encode())
+            for i in range(n)
+        ]
+
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "c.bin")
+        exs = self._examples(5)
+        assert write_chunk_file(path, exs) == 5
+        back = list(read_chunk_file(path))
+        assert back == exs
+
+    def test_wire_format_length_prefix(self, tmp_path):
+        path = str(tmp_path / "c.bin")
+        ex = self._examples(1)[0]
+        write_chunk_file(path, [ex])
+        raw = open(path, "rb").read()
+        (ln,) = struct.unpack("<q", raw[:8])
+        assert ln == len(raw) - 8
+        assert TFExample.parse(raw[8:]) == ex
+
+    def test_generator_single_pass_sorted(self, tmp_path):
+        write_chunked(str(tmp_path / "train"), self._examples(25), chunk_size=10)
+        assert len(list((tmp_path).glob("train_*.bin"))) == 3
+        got = [ex.get_str("article")
+               for ex in example_generator(str(tmp_path / "train_*.bin"), True)]
+        assert got == [f"article {i}" for i in range(25)]
+
+    def test_generator_empty_glob_asserts(self, tmp_path):
+        with pytest.raises(AssertionError):
+            next(example_generator(str(tmp_path / "nope_*.bin"), True))
+
+    def test_bin2txt(self, tmp_path):
+        write_chunked(str(tmp_path / "t"), self._examples(3), chunk_size=10)
+        out = str(tmp_path / "out.jsonl")
+        assert bin2txt(str(tmp_path / "t_*.bin"), out) == 3
+        import json
+        lines = [json.loads(l) for l in open(out)]
+        assert lines[0]["article"] == "article 0"
+
+
+class TestHParams:
+    def test_defaults_match_reference_flags(self):
+        from textsummarization_on_flink_tpu.config import HParams
+        h = HParams()
+        assert (h.hidden_dim, h.emb_dim, h.batch_size) == (256, 128, 16)
+        assert (h.max_enc_steps, h.max_dec_steps, h.beam_size) == (400, 100, 4)
+        assert (h.min_dec_steps, h.vocab_size) == (35, 50000)
+        assert (h.lr, h.adagrad_init_acc, h.max_grad_norm) == (0.15, 0.1, 2.0)
+        assert h.pointer_gen and not h.coverage and h.cov_loss_wt == 1.0
+
+    def test_argv_roundtrip(self):
+        from textsummarization_on_flink_tpu.config import HParams
+        argv = ("--mode decode --batch_size=4 --coverage=True --lr 0.01 "
+                "--exp_name pretrained --single_pass").split(" ")
+        h = HParams.from_argv(argv)
+        assert h.mode == "decode" and h.batch_size == 4 and h.coverage
+        assert h.lr == 0.01 and h.exp_name == "pretrained" and h.single_pass
+        h2 = HParams.from_argv(h.to_argv().split(" "))
+        assert h2 == h
+
+    def test_bare_bool_then_positional(self):
+        from textsummarization_on_flink_tpu.config import HParams
+        h = HParams.from_argv(["--single_pass", "train_*.bin", "--mode", "eval"])
+        assert h.single_pass is True and h.mode == "eval"
+        # non-bool flag with missing value is skipped, not crashed
+        h2 = HParams.from_argv(["--num_steps", "--mode=eval"])
+        assert h2.num_steps == 0 and h2.mode == "eval"
+
+    def test_from_string_quoted_spaces(self):
+        from textsummarization_on_flink_tpu.config import HParams
+        h = HParams(data_path="/data/my runs/train_*.bin")
+        h2 = HParams.from_string(h.to_argv())
+        assert h2 == h
+
+    def test_json_roundtrip_and_validate(self):
+        from textsummarization_on_flink_tpu.config import HParams
+        h = HParams(mode="eval", hidden_dim=512)
+        assert HParams.from_json(h.to_json()) == h
+        h.validate()
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            HParams(mode="bogus").validate()

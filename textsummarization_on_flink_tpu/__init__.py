@@ -1,0 +1,23 @@
+"""textsummarization_on_flink_tpu — a TPU-native text-summarization framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of the reference
+`yangzichuang/TextSummarization-On-Flink` project (pointer-generator
+abstractive summarization served through an Estimator/Model streaming
+pipeline).  The compute path is JAX (jit/pjit/shard_map over a TPU mesh);
+the control plane is a typed Params + Estimator/Model/Pipeline API; the
+data plane is a host-side feed/fetch bridge with pluggable stream
+sources/sinks.
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
+  pipeline/   Estimator/Model/Pipeline API, params, sources/sinks, app
+  train/      jitted/pjitted training loop, optimizer, eval + early stop
+  decode/     on-device batched beam search, ROUGE, decode drivers
+  models/     pointer-generator (LSTM) and transformer model families
+  ops/        attention/coverage/final-dist/loss ops (+ Pallas kernels)
+  parallel/   mesh, sharding rules, collectives, context parallelism
+  data/       vocab, tf.Example codec, chunk IO, batching
+  checkpoint/ save/restore, retention, surgery, inspection
+  runtime/    native (C++) host bridge
+"""
+
+__version__ = "0.1.0"

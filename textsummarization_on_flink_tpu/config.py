@@ -1,0 +1,177 @@
+"""Typed hyperparameter configuration.
+
+Replaces the reference's 23 `tf.app.flags` definitions
+(/root/reference/src/main/python/pointer-generator/run_summarization.py:48-88)
+and the stringly-typed `TF_Hyperparameter` argv hand-off
+(TFEstimator.java:52 -> run_summarization.py:418-420) with one frozen
+dataclass.  Field names and defaults match the reference flag surface so
+every reference invocation has a 1:1 equivalent here; `HParams.from_argv`
+still accepts the reference's ``--flag=value`` argv string form for
+pipeline-level compatibility.
+
+TPU-specific additions (not in the reference):
+  * ``max_oov_buckets`` — static in-article-OOV budget.  The reference uses
+    a dynamic per-batch ``max_art_oovs`` (model.py:45,162); XLA needs static
+    shapes, so we pad the extended vocabulary to a fixed budget.
+  * ``compute_dtype`` — bf16 compute on the MXU (params stay f32).
+  * mesh axis sizes (``dp``/``tp``/``sp``) for pjit/shard_map sharding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class HParams:
+    # Where to find data (run_summarization.py:48-50)
+    data_path: str = ""
+    vocab_path: str = ""
+
+    # Important settings (run_summarization.py:52-56)
+    mode: str = "train"  # train / eval / decode
+    num_steps: int = 0  # 0 = never stop
+    single_pass: bool = False
+    inference: bool = False  # decode from raw text files
+
+    # Where to save output (run_summarization.py:58-60)
+    log_root: str = ""
+    exp_name: str = ""
+
+    # Model hyperparameters (run_summarization.py:62-74)
+    hidden_dim: int = 256
+    emb_dim: int = 128
+    batch_size: int = 16
+    max_enc_steps: int = 400
+    max_dec_steps: int = 100
+    beam_size: int = 4
+    min_dec_steps: int = 35
+    vocab_size: int = 50000
+    lr: float = 0.15
+    adagrad_init_acc: float = 0.1
+    rand_unif_init_mag: float = 0.02
+    trunc_norm_init_std: float = 1e-4
+    max_grad_norm: float = 2.0
+
+    # Pointer-generator / coverage (run_summarization.py:76-81)
+    pointer_gen: bool = True
+    coverage: bool = False
+    cov_loss_wt: float = 1.0
+
+    # Checkpoint surgery flags (run_summarization.py:83-85)
+    convert_to_coverage_model: bool = False
+    restore_best_model: bool = False
+
+    # Debugging (run_summarization.py:88)
+    debug: bool = False
+
+    # ---- TPU-native additions ----
+    max_oov_buckets: int = 128  # static extended-vocab budget
+    compute_dtype: str = "float32"  # or "bfloat16"
+    seed: int = 111  # reference seeds tf at 111 (run_summarization.py:329)
+    dp: int = 1  # data-parallel mesh axis size
+    tp: int = 1  # tensor-parallel mesh axis size (output projection)
+    sp: int = 1  # sequence/context-parallel mesh axis size
+    model_family: str = "pointer_generator"  # or "transformer"
+
+    # -- derived --
+    @property
+    def extended_vsize(self) -> int:
+        return self.vocab_size + self.max_oov_buckets
+
+    def replace(self, **kw: Any) -> "HParams":
+        return dataclasses.replace(self, **kw)
+
+    def for_decode(self) -> "HParams":
+        """Decode mode forces batch_size=beam_size in the reference
+        (run_summarization.py:312-313); on-device beam search keeps an
+        independent batch axis, but we mirror the mode switch."""
+        return self.replace(mode="decode")
+
+    # -- (de)serialization --
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "HParams":
+        d = json.loads(s)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_argv(cls, argv: List[str]) -> "HParams":
+        """Parse the reference's space-joined ``--flag value`` /
+        ``--flag=value`` hyperparameter string (known flags only, like
+        FLAGS(known_only=True) at run_summarization.py:420)."""
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        bool_literals = ("1", "0", "true", "false", "yes", "no")
+        out: Dict[str, Any] = {}
+        i = 0
+        toks = [t for t in argv if t]
+        while i < len(toks):
+            tok = toks[i]
+            if not tok.startswith("--"):
+                i += 1
+                continue
+            body = tok[2:]
+            is_bool = body.split("=", 1)[0] in fields and \
+                fields[body.split("=", 1)[0]].type in ("bool", bool)
+            if "=" in body:
+                name, val = body.split("=", 1)
+                i += 1
+            elif (i + 1 < len(toks) and not toks[i + 1].startswith("--")
+                  and not (is_bool and toks[i + 1].lower() not in bool_literals)):
+                # separate-token value; for booleans only consume a literal,
+                # so `--single_pass train_*.bin` reads as a bare True flag
+                name, val = body, toks[i + 1]
+                i += 2
+            elif is_bool:  # bare boolean flag
+                name, val = body, "True"
+                i += 1
+            else:  # non-bool flag with no value: skip it
+                i += 1
+                continue
+            if name not in fields:
+                continue
+            ftype = fields[name].type
+            if ftype in ("bool", bool):
+                out[name] = str(val).lower() in ("1", "true", "yes")
+            elif ftype in ("int", int):
+                out[name] = int(val)
+            elif ftype in ("float", float):
+                out[name] = float(val)
+            else:
+                out[name] = val
+        return cls(**out)
+
+    def to_argv(self) -> str:
+        """Render as the reference's hyperparameter string form.  Values
+        with whitespace are shell-quoted; parse back with `from_string`."""
+        import shlex
+
+        parts = []
+        for f in dataclasses.fields(self):
+            v = str(getattr(self, f.name))
+            quoted = shlex.quote(v) if v else ""  # empty stays `--flag=`
+            parts.append(f"--{f.name}={quoted}")
+        return " ".join(parts)
+
+    @classmethod
+    def from_string(cls, s: str) -> "HParams":
+        """Parse a whole hyperparameter string (shlex-split, so quoted
+        values containing spaces survive the round trip)."""
+        import shlex
+
+        return cls.from_argv(shlex.split(s))
+
+    def validate(self) -> None:
+        if self.mode not in ("train", "eval", "decode"):
+            raise ValueError(f"mode must be train/eval/decode, got {self.mode!r}")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"bad compute_dtype {self.compute_dtype!r}")
+        if self.max_dec_steps < 1 or self.max_enc_steps < 1:
+            raise ValueError("max_enc_steps/max_dec_steps must be >= 1")
+        if self.min_dec_steps >= self.max_dec_steps:
+            raise ValueError("min_dec_steps must be < max_dec_steps")

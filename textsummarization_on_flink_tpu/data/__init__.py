@@ -1,0 +1,27 @@
+"""Data layer: vocab, tf.Example codec, chunk IO, OOV machinery, batching."""
+
+from textsummarization_on_flink_tpu.data.vocab import (  # noqa: F401
+    PAD_TOKEN,
+    SENTENCE_END,
+    SENTENCE_START,
+    START_DECODING,
+    STOP_DECODING,
+    UNKNOWN_TOKEN,
+    Vocab,
+)
+from textsummarization_on_flink_tpu.data.tfexample import (  # noqa: F401
+    Example as TFExample,
+)
+from textsummarization_on_flink_tpu.data.oov import (  # noqa: F401
+    abstract2ids,
+    abstract2sents,
+    article2ids,
+    outputids2words,
+    show_abs_oovs,
+    show_art_oovs,
+)
+from textsummarization_on_flink_tpu.data.chunks import (  # noqa: F401
+    example_generator,
+    read_chunk_file,
+    write_chunk_file,
+)

@@ -1,0 +1,100 @@
+"""Length-prefixed binary chunk files of serialized tf.Example records.
+
+On-disk format parity with the reference (data.py:108-141 reader,
+make_datafiles.py:150-209 writer): each record is an 8-byte little-endian
+signed length followed by that many bytes of serialized tf.Example proto.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import random
+import struct
+from typing import Iterable, Iterator, List, Optional
+
+from textsummarization_on_flink_tpu.data.tfexample import Example
+
+
+def write_chunk_file(path: str, examples: Iterable[Example]) -> int:
+    """Write examples to one chunk file; returns the record count."""
+    n = 0
+    with open(path, "wb") as f:
+        for ex in examples:
+            blob = ex.serialize()
+            f.write(struct.pack("<q", len(blob)))
+            f.write(blob)
+            n += 1
+    return n
+
+
+def read_chunk_file(path: str) -> Iterator[Example]:
+    with open(path, "rb") as f:
+        while True:
+            len_bytes = f.read(8)
+            if not len_bytes:
+                break
+            if len(len_bytes) != 8:
+                raise ValueError(f"truncated length prefix in {path}")
+            (str_len,) = struct.unpack("<q", len_bytes)
+            blob = f.read(str_len)
+            if len(blob) != str_len:
+                raise ValueError(f"truncated record in {path}")
+            yield Example.parse(blob)
+
+
+def example_generator(data_path: str, single_pass: bool,
+                      rng: Optional[random.Random] = None) -> Iterator[Example]:
+    """Yield Examples from a glob of chunk files (data.py:108-141 semantics).
+
+    single_pass=True: sorted file order, one epoch, then stop.
+    single_pass=False: shuffle the file list each epoch, loop forever.
+    """
+    rng = rng or random.Random()
+    while True:
+        filelist = glob.glob(data_path)
+        assert filelist, f"Error: Empty filelist at {data_path}"
+        if single_pass:
+            filelist = sorted(filelist)
+        else:
+            rng.shuffle(filelist)
+        for f in filelist:
+            yield from read_chunk_file(f)
+        if single_pass:
+            break
+
+
+def write_chunked(prefix: str, examples: List[Example],
+                  chunk_size: int = 1000) -> List[str]:
+    """Write examples into `<prefix>_000.bin`, `<prefix>_001.bin`, ...
+    (make_datafiles.py:36-64 chunking scheme)."""
+    n_chunks = max((len(examples) + chunk_size - 1) // chunk_size, 1)
+    width = max(3, len(str(n_chunks - 1)))  # keep lexicographic == numeric order
+    paths = []
+    for i in range(0, max(len(examples), 1), chunk_size):
+        path = f"{prefix}_{i // chunk_size:0{width}d}.bin"
+        write_chunk_file(path, examples[i : i + chunk_size])
+        paths.append(path)
+    return paths
+
+
+def bin2txt(data_path: str, out_path: str, limit: int = 0) -> int:
+    """Convert chunked bins to JSON lines for stream seeding
+    (util.py:44-99 capability parity). Each line carries the example's
+    article/abstract as strings."""
+    import json
+
+    def _jsonable(vals):
+        vals = [v.decode("utf-8", errors="replace") if isinstance(v, bytes) else v
+                for v in vals]
+        return vals[0] if len(vals) == 1 else vals
+
+    n = 0
+    with open(out_path, "w", encoding="utf-8") as out:
+        for ex in example_generator(data_path, single_pass=True):
+            rec = {k: _jsonable(v) for k, v in ex.features.items()}
+            out.write(json.dumps(rec) + "\n")
+            n += 1
+            if limit and n >= limit:
+                break
+    return n

@@ -1,0 +1,108 @@
+"""Pointer-generator extended-vocabulary (in-article OOV) machinery.
+
+Behavior parity with data.py:144-276 of the reference: in-article OOVs get
+temporary ids vocab_size+0, vocab_size+1, ... in order of first appearance;
+abstract words map to those temp ids when copyable, else UNK; output ids map
+back to words through the per-article OOV list.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from textsummarization_on_flink_tpu.data.vocab import (
+    SENTENCE_END,
+    SENTENCE_START,
+    UNKNOWN_TOKEN,
+    Vocab,
+)
+
+
+def article2ids(article_words: Sequence[str], vocab: Vocab) -> Tuple[List[int], List[str]]:
+    ids: List[int] = []
+    oovs: List[str] = []
+    unk_id = vocab.word2id(UNKNOWN_TOKEN)
+    for w in article_words:
+        i = vocab.word2id(w)
+        if i == unk_id:
+            if w not in oovs:
+                oovs.append(w)
+            ids.append(vocab.size() + oovs.index(w))
+        else:
+            ids.append(i)
+    return ids, oovs
+
+
+def abstract2ids(abstract_words: Sequence[str], vocab: Vocab,
+                 article_oovs: Sequence[str]) -> List[int]:
+    ids: List[int] = []
+    unk_id = vocab.word2id(UNKNOWN_TOKEN)
+    for w in abstract_words:
+        i = vocab.word2id(w)
+        if i == unk_id:
+            if w in article_oovs:
+                ids.append(vocab.size() + article_oovs.index(w))
+            else:
+                ids.append(unk_id)
+        else:
+            ids.append(i)
+    return ids
+
+
+def outputids2words(id_list: Sequence[int], vocab: Vocab,
+                    article_oovs: Optional[Sequence[str]]) -> List[str]:
+    words: List[str] = []
+    for i in id_list:
+        try:
+            w = vocab.id2word(i)
+        except ValueError:
+            assert article_oovs is not None, (
+                "Error: model produced a word ID that isn't in the vocabulary. "
+                "This should not happen in baseline (no pointer-generator) mode")
+            article_oov_idx = i - vocab.size()
+            if article_oov_idx < 0 or article_oov_idx >= len(article_oovs):
+                raise ValueError(
+                    f"Error: model produced word ID {i} which corresponds to "
+                    f"article OOV {article_oov_idx} but this example only has "
+                    f"{len(article_oovs)} article OOVs")
+            w = article_oovs[article_oov_idx]
+        words.append(w)
+    return words
+
+
+def abstract2sents(abstract: str) -> List[str]:
+    """Split '<s> ... </s>'-delimited abstract text into sentences."""
+    cur = 0
+    sents: List[str] = []
+    while True:
+        try:
+            start_p = abstract.index(SENTENCE_START, cur)
+            end_p = abstract.index(SENTENCE_END, start_p + 1)
+            cur = end_p + len(SENTENCE_END)
+            sents.append(abstract[start_p + len(SENTENCE_START):end_p])
+        except ValueError:
+            return sents
+
+
+def show_art_oovs(article: str, vocab: Vocab) -> str:
+    unk_id = vocab.word2id(UNKNOWN_TOKEN)
+    words = article.split(" ")
+    words = [f"__{w}__" if vocab.word2id(w) == unk_id else w for w in words]
+    return " ".join(words)
+
+
+def show_abs_oovs(abstract: str, vocab: Vocab,
+                  article_oovs: Optional[Sequence[str]]) -> str:
+    unk_id = vocab.word2id(UNKNOWN_TOKEN)
+    new_words = []
+    for w in abstract.split(" "):
+        if vocab.word2id(w) == unk_id:
+            if article_oovs is None:
+                new_words.append(f"__{w}__")
+            elif w in article_oovs:
+                new_words.append(f"__{w}__")
+            else:
+                new_words.append(f"!!__{w}__!!")
+        else:
+            new_words.append(w)
+    return " ".join(new_words)

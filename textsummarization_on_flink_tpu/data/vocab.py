@@ -1,0 +1,104 @@
+"""Vocabulary with the reference's exact special-token id assignment.
+
+Behavior parity with /root/reference/src/main/python/pointer-generator/
+data.py:26-105: specials [UNK]=0, [PAD]=1, [START]=2, [STOP]=3; vocab file
+is "<word> <freq>" lines, most frequent first; malformed lines are skipped
+with a warning; <s>/</s>/specials in the file are an error; duplicates are
+an error; reading stops at max_size (0 = unlimited).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Iterable, List, Optional
+
+log = logging.getLogger(__name__)
+
+SENTENCE_START = "<s>"
+SENTENCE_END = "</s>"
+
+PAD_TOKEN = "[PAD]"
+UNKNOWN_TOKEN = "[UNK]"
+START_DECODING = "[START]"
+STOP_DECODING = "[STOP]"
+
+_SPECIALS = (UNKNOWN_TOKEN, PAD_TOKEN, START_DECODING, STOP_DECODING)
+_FORBIDDEN = (SENTENCE_START, SENTENCE_END) + _SPECIALS
+
+UNK_ID = 0
+PAD_ID = 1
+START_ID = 2
+STOP_ID = 3
+
+
+class Vocab:
+    """Word <-> id mapping (data.py:37-105 semantics)."""
+
+    def __init__(self, vocab_file: Optional[str] = None, max_size: int = 0,
+                 words: Optional[Iterable[str]] = None):
+        """Build from a vocab file, or directly from an iterable of words
+        (test convenience; words must not include specials)."""
+        self._word_to_id: Dict[str, int] = {}
+        self._id_to_word: Dict[int, str] = {}
+        self._count = 0
+        for w in _SPECIALS:
+            self._word_to_id[w] = self._count
+            self._id_to_word[self._count] = w
+            self._count += 1
+
+        if vocab_file is not None:
+            with open(vocab_file, "r", encoding="utf-8") as f:
+                for line in f:
+                    pieces = line.split()
+                    if len(pieces) != 2:
+                        log.warning(
+                            "incorrectly formatted line in vocabulary file: %r", line)
+                        continue
+                    self._add(pieces[0])
+                    if max_size != 0 and self._count >= max_size:
+                        log.info(
+                            "max_size of vocab was specified as %i; we now have %i "
+                            "words. Stopping reading.", max_size, self._count)
+                        break
+        if words is not None:
+            for w in words:
+                self._add(w)
+                if max_size != 0 and self._count >= max_size:
+                    break
+        log.info("Finished constructing vocabulary of %i total words. "
+                 "Last word added: %s", self._count, self._id_to_word[self._count - 1])
+
+    def _add(self, w: str) -> None:
+        if w in _FORBIDDEN:
+            raise ValueError(
+                f"<s>, </s>, [UNK], [PAD], [START] and [STOP] shouldn't be in "
+                f"the vocab file, but {w} is")
+        if w in self._word_to_id:
+            raise ValueError(f"Duplicated word in vocabulary file: {w}")
+        self._word_to_id[w] = self._count
+        self._id_to_word[self._count] = w
+        self._count += 1
+
+    def word2id(self, word: str) -> int:
+        return self._word_to_id.get(word, self._word_to_id[UNKNOWN_TOKEN])
+
+    def id2word(self, word_id: int) -> str:
+        if word_id not in self._id_to_word:
+            raise ValueError(f"Id not found in vocab: {word_id}")
+        return self._id_to_word[word_id]
+
+    def size(self) -> int:
+        return self._count
+
+    def __contains__(self, word: str) -> bool:
+        return word in self._word_to_id
+
+    def words(self) -> List[str]:
+        return [self._id_to_word[i] for i in range(self._count)]
+
+    def write_metadata(self, fpath: str) -> None:
+        """Embedding-projector metadata: one word per line (data.py:93-105)."""
+        log.info("Writing word embedding metadata file to %s...", fpath)
+        with open(fpath, "w", encoding="utf-8") as f:
+            for i in range(self.size()):
+                f.write(self._id_to_word[i] + "\n")
