@@ -1,0 +1,175 @@
+"""Batch packing + threaded batcher tests (reference batcher.py semantics)."""
+
+import numpy as np
+import pytest
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data import TFExample, Vocab
+from textsummarization_on_flink_tpu.data.batching import (
+    Batch,
+    SummaryExample,
+    get_dec_inp_targ_seqs,
+)
+from textsummarization_on_flink_tpu.data.batcher import Batcher
+from textsummarization_on_flink_tpu.data.chunks import write_chunked
+from textsummarization_on_flink_tpu.data.vocab import PAD_ID, START_ID, STOP_ID, UNK_ID
+
+
+def small_hps(**kw):
+    base = dict(batch_size=2, max_enc_steps=8, max_dec_steps=6, min_dec_steps=2,
+                max_oov_buckets=4, vocab_size=0)
+    base.update(kw)
+    return HParams(**base)
+
+
+def make_vocab():
+    return Vocab(words=["the", "cat", "sat", "on", "mat", "."])  # size 10
+
+
+class TestDecInpTarg:
+    def test_no_truncation_appends_stop(self):
+        inp, tgt = get_dec_inp_targ_seqs([5, 6, 7], 6, START_ID, STOP_ID)
+        assert inp == [START_ID, 5, 6, 7]
+        assert tgt == [5, 6, 7, STOP_ID]
+
+    def test_truncation_drops_stop(self):
+        inp, tgt = get_dec_inp_targ_seqs([5, 6, 7, 8, 9], 4, START_ID, STOP_ID)
+        assert inp == [START_ID, 5, 6, 7]
+        assert tgt == [5, 6, 7, 8]  # same length, no STOP
+
+
+class TestSummaryExample:
+    def test_truncation_and_oov(self):
+        v = make_vocab()
+        hps = small_hps(max_enc_steps=4)
+        art = "the cat zebra sat on mat"  # truncated to 4 words
+        ex = SummaryExample.build(art, ["the zebra ."], v, hps)
+        assert ex.enc_len == 4
+        assert ex.enc_input == [4, 5, UNK_ID, 6]
+        assert ex.enc_input_extend_vocab == [4, 5, v.size(), 6]
+        assert ex.article_oovs == ["zebra"]
+        # target uses the temp OOV id for the copyable zebra
+        assert ex.target == [4, v.size(), 9, STOP_ID]
+
+    def test_dec_truncation(self):
+        v = make_vocab()
+        hps = small_hps(max_dec_steps=3)
+        ex = SummaryExample.build("the cat", ["the cat sat on mat ."], v, hps)
+        assert ex.dec_len == 3
+        assert ex.dec_input[0] == START_ID
+        assert STOP_ID not in ex.target
+
+
+class TestBatch:
+    def test_static_shapes_and_masks(self):
+        v = make_vocab()
+        hps = small_hps()
+        exs = [SummaryExample.build("the cat", ["the ."], v, hps),
+               SummaryExample.build("the cat sat on mat", ["cat ."], v, hps)]
+        b = Batch(exs, hps, v)
+        assert b.enc_batch.shape == (2, 8)
+        assert b.dec_batch.shape == (2, 6)
+        assert b.enc_batch.dtype == np.int32
+        np.testing.assert_array_equal(b.enc_lens, [2, 5])
+        assert b.enc_padding_mask[0].sum() == 2 and b.enc_padding_mask[1].sum() == 5
+        # padding slots hold PAD
+        assert (b.enc_batch[0, 2:] == PAD_ID).all()
+        arrays = b.as_arrays()
+        assert set(arrays) == {"enc_batch", "enc_lens", "enc_padding_mask",
+                               "enc_batch_extend_vocab", "dec_batch",
+                               "target_batch", "dec_padding_mask"}
+
+    def test_oov_budget_clamping(self):
+        v = make_vocab()
+        hps = small_hps(max_oov_buckets=2, batch_size=1)
+        art = "z1 z2 z3 z4"  # 4 OOVs, budget 2
+        ex = SummaryExample.build(art, ["z1 z3 ."], v, hps)
+        b = Batch([ex], hps, v)
+        ext = b.enc_batch_extend_vocab[0, :4]
+        assert list(ext[:2]) == [v.size(), v.size() + 1]
+        assert list(ext[2:]) == [UNK_ID, UNK_ID]  # beyond budget -> UNK
+        # target: z1 within budget keeps temp id, z3 clamped
+        assert b.target_batch[0, 0] == v.size()
+        assert b.target_batch[0, 1] == UNK_ID
+        assert b.max_art_oovs == 2
+
+    def test_wrong_batch_size_raises(self):
+        v = make_vocab()
+        hps = small_hps()
+        ex = SummaryExample.build("the", ["the ."], v, hps)
+        with pytest.raises(ValueError):
+            Batch([ex], hps, v)
+
+
+def _write_dataset(tmp_path, v, n=20):
+    exs = []
+    for i in range(n):
+        words = ["the", "cat", "sat"][: (i % 3) + 1] * (i % 4 + 1)
+        art = " ".join(words)
+        exs.append(TFExample().set_bytes("article", art.encode())
+                   .set_bytes("abstract", f"<s> the cat . </s>".encode()))
+    write_chunked(str(tmp_path / "train"), exs, chunk_size=7)
+    return str(tmp_path / "train_*.bin")
+
+
+class TestBatcher:
+    def test_single_pass_yields_all_then_none(self, tmp_path):
+        v = make_vocab()
+        hps = small_hps(batch_size=4, mode="train")
+        pattern = _write_dataset(tmp_path, v, n=10)
+        b = Batcher(pattern, v, hps, single_pass=True)
+        seen = 0
+        batches = 0
+        while True:
+            batch = b.next_batch()
+            if batch is None:
+                break
+            batches += 1
+            seen += int(batch.enc_padding_mask.shape[0])
+            assert batch.enc_batch.shape == (4, 8)
+            if batches > 10:
+                pytest.fail("batcher did not terminate")
+        # 10 examples -> 3 batches (last padded by repeating)
+        assert batches == 3
+
+    def test_decode_repeat_mode(self, tmp_path):
+        v = make_vocab()
+        hps = small_hps(batch_size=4, mode="decode")
+        pattern = _write_dataset(tmp_path, v, n=3)
+        b = Batcher(pattern, v, hps, single_pass=True, decode_batch_mode="repeat")
+        batch = b.next_batch()
+        # one example repeated across the batch
+        assert all(a == batch.original_articles[0] for a in batch.original_articles)
+
+    def test_decode_distinct_mode(self, tmp_path):
+        v = make_vocab()
+        hps = small_hps(batch_size=2, mode="decode")
+        pattern = _write_dataset(tmp_path, v, n=4)
+        b = Batcher(pattern, v, hps, single_pass=True, decode_batch_mode="distinct")
+        batch = b.next_batch()
+        assert len(set(batch.original_articles)) == 2
+
+    def test_empty_article_skipped(self, tmp_path):
+        v = make_vocab()
+        hps = small_hps(batch_size=1, mode="train")
+        exs = [TFExample().set_bytes("article", b"").set_bytes("abstract", b"x"),
+               TFExample().set_bytes("article", b"the cat")
+               .set_bytes("abstract", b"<s> the . </s>")]
+        write_chunked(str(tmp_path / "t"), exs, chunk_size=10)
+        b = Batcher(str(tmp_path / "t_*.bin"), v, hps, single_pass=True)
+        batch = b.next_batch()
+        assert batch.original_articles == ["the cat"]
+        assert b.next_batch() is None
+
+    def test_streaming_example_source(self):
+        v = make_vocab()
+        hps = small_hps(batch_size=2, mode="train")
+
+        def source():
+            for i in range(4):
+                yield f"the cat {i}", "<s> the . </s>"
+
+        b = Batcher("", v, hps, single_pass=True, example_source=source)
+        batch = b.next_batch()
+        assert batch is not None
+        assert batch.enc_batch.shape == (2, 8)

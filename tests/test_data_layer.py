@@ -5,7 +5,6 @@ the exact-parity behaviors: special-token ids, OOV temp-id assignment,
 chunk wire format, abstract sentence splitting.
 """
 
-import os
 import struct
 
 import pytest

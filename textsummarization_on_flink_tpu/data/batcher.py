@@ -1,0 +1,209 @@
+"""Threaded, bucketing batcher.
+
+Behavior parity with the reference Batcher
+(/root/reference/src/main/python/pointer-generator/batcher.py:222-379):
+producer-consumer queues (16 example threads + 4 batch threads when
+streaming, 1+1 in single_pass), length-bucketing over a
+100-batch cache with batch-order shuffling, decode mode repeating one
+example batch_size times, a watcher thread restarting dead workers, and
+empty-article skipping.
+
+TPU-first difference: emitted Batches are static-shape (padded to
+``hps.max_enc_steps``) — see batching.py.  ``decode_batch_mode='distinct'``
+additionally allows batches of distinct articles in decode mode, because
+the on-device beam search keeps its own beam axis and can decode a whole
+batch of articles per dispatch (the reference needs the repeat because its
+beam occupies the batch axis, batcher.py:344-347).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import threading
+import time
+from typing import Callable, Iterator, Optional, Tuple
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data import chunks, oov as oov_lib
+from textsummarization_on_flink_tpu.data.batching import Batch, SummaryExample
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+
+log = logging.getLogger(__name__)
+
+
+class Batcher:
+    BATCH_QUEUE_MAX = 100
+
+    def __init__(self, data_path: str, vocab: Vocab, hps: HParams,
+                 single_pass: bool, decode_batch_mode: str = "repeat",
+                 watch_interval: float = 60.0,
+                 example_source: Optional[Callable[[], Iterator[Tuple[str, str]]]] = None):
+        """
+        Args:
+          data_path: chunk-file glob (ignored when example_source given).
+          decode_batch_mode: 'repeat' mirrors the reference (one example
+            repeated across the batch); 'distinct' packs distinct articles.
+          example_source: optional zero-arg callable returning an iterator of
+            (article, abstract) string pairs — the streaming-bridge hook.
+        """
+        self._data_path = data_path
+        self._vocab = vocab
+        self._hps = hps
+        self._single_pass = single_pass
+        self._decode_batch_mode = decode_batch_mode
+        self._example_source = example_source
+        self._watch_interval = watch_interval
+
+        self._batch_queue: "queue.Queue[Batch]" = queue.Queue(self.BATCH_QUEUE_MAX)
+        self._example_queue: "queue.Queue[SummaryExample]" = queue.Queue(
+            self.BATCH_QUEUE_MAX * hps.batch_size)
+
+        if single_pass:
+            self._num_example_q_threads = 1
+            self._num_batch_q_threads = 1
+            self._bucketing_cache_size = 1
+            self._finished_reading = False
+        else:
+            self._num_example_q_threads = 16
+            self._num_batch_q_threads = 4
+            self._bucketing_cache_size = 100
+
+        self._example_q_threads = []
+        for _ in range(self._num_example_q_threads):
+            t = threading.Thread(target=self._fill_example_queue, daemon=True)
+            self._example_q_threads.append(t)
+            t.start()
+        self._batch_q_threads = []
+        for _ in range(self._num_batch_q_threads):
+            t = threading.Thread(target=self._fill_batch_queue, daemon=True)
+            self._batch_q_threads.append(t)
+            t.start()
+
+        if not single_pass:
+            self._watch_thread = threading.Thread(target=self._watch_threads,
+                                                  daemon=True)
+            self._watch_thread.start()
+
+    # -- consumer API --
+    def next_batch(self) -> Optional[Batch]:
+        """Next Batch, or None when a single_pass dataset is exhausted."""
+        if self._batch_queue.qsize() == 0:
+            log.warning(
+                "Bucket input queue is empty when calling next_batch. "
+                "Bucket queue size: %i, Input queue size: %i",
+                self._batch_queue.qsize(), self._example_queue.qsize())
+            if self._single_pass and self._finished_reading:
+                # drain stragglers the batch thread may still be packing
+                for _ in range(100):
+                    if self._batch_queue.qsize() or not any(
+                            t.is_alive() for t in self._batch_q_threads):
+                        break
+                    time.sleep(0.05)
+                if self._batch_queue.qsize() == 0:
+                    log.info("Finished reading dataset in single_pass mode.")
+                    return None
+        return self._batch_queue.get()
+
+    # -- producers --
+    def _text_pairs(self) -> Iterator[Tuple[str, str]]:
+        if self._example_source is not None:
+            yield from self._example_source()
+            return
+        for e in chunks.example_generator(self._data_path, self._single_pass):
+            article = e.get_str("article")
+            abstract = e.get_str("abstract")
+            if len(article) == 0:
+                log.warning("Found an example with empty article text. Skipping it.")
+                continue
+            yield article, abstract
+
+    def _fill_example_queue(self) -> None:
+        gen = self._text_pairs()
+        while True:
+            try:
+                article, abstract = next(gen)
+            except StopIteration:
+                log.info("example generator exhausted data.")
+                if self._single_pass:
+                    self._finished_reading = True
+                    break
+                raise Exception(
+                    "single_pass mode is off but the example generator is "
+                    "out of data; error.")
+            abstract_sentences = [
+                s.strip() for s in oov_lib.abstract2sents(abstract)]
+            ex = SummaryExample.build(article, abstract_sentences, self._vocab,
+                                      self._hps)
+            self._example_queue.put(ex)
+
+    def _get_example(self, timeout: Optional[float] = None) -> Optional[SummaryExample]:
+        """example_queue.get that gives up once a single_pass read finished."""
+        while True:
+            try:
+                return self._example_queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._single_pass and self._finished_reading:
+                    return None
+
+    def _fill_batch_queue(self) -> None:
+        hps = self._hps
+        while True:
+            if hps.mode != "decode":
+                inputs = []
+                for _ in range(hps.batch_size * self._bucketing_cache_size):
+                    ex = self._get_example()
+                    if ex is None:
+                        break
+                    inputs.append(ex)
+                if not inputs:
+                    break  # single_pass exhausted
+                if self._single_pass and len(inputs) % hps.batch_size != 0:
+                    # pad the tail batch by repeating the last example so the
+                    # static batch shape holds; consumers can drop repeats
+                    pad = hps.batch_size - len(inputs) % hps.batch_size
+                    inputs.extend([inputs[-1]] * pad)
+                inputs.sort(key=lambda ex: ex.enc_len)  # length bucketing
+                batches = [inputs[i : i + hps.batch_size]
+                           for i in range(0, len(inputs), hps.batch_size)]
+                if not self._single_pass:
+                    random.shuffle(batches)
+                for b in batches:
+                    self._batch_queue.put(Batch(b, hps, self._vocab))
+            elif self._decode_batch_mode == "repeat":
+                ex = self._get_example()
+                if ex is None:
+                    break
+                b = [ex] * hps.batch_size
+                self._batch_queue.put(Batch(b, hps, self._vocab))
+            else:  # 'distinct': fill a whole batch of different articles
+                exs = []
+                for _ in range(hps.batch_size):
+                    ex = self._get_example()
+                    if ex is None:
+                        break
+                    exs.append(ex)
+                if not exs:
+                    break
+                while len(exs) < hps.batch_size:
+                    exs.append(exs[-1])
+                self._batch_queue.put(Batch(exs, hps, self._vocab))
+
+    def _watch_threads(self) -> None:
+        while True:
+            time.sleep(self._watch_interval)
+            for idx, t in enumerate(self._example_q_threads):
+                if not t.is_alive():
+                    log.error("Found example queue thread dead. Restarting.")
+                    new_t = threading.Thread(target=self._fill_example_queue,
+                                             daemon=True)
+                    self._example_q_threads[idx] = new_t
+                    new_t.start()
+            for idx, t in enumerate(self._batch_q_threads):
+                if not t.is_alive():
+                    log.error("Found batch queue thread dead. Restarting.")
+                    new_t = threading.Thread(target=self._fill_batch_queue,
+                                             daemon=True)
+                    self._batch_q_threads[idx] = new_t
+                    new_t.start()

@@ -1,0 +1,181 @@
+"""Example -> static-shape Batch packing for TPU.
+
+Semantics parity with the reference's Example/Batch
+(/root/reference/src/main/python/pointer-generator/batcher.py:33-219), with
+one deliberate TPU-first change: the reference pads the encoder side to the
+*batch* max length (batcher.py:159-167, possible because dynamic_rnn takes
+dynamic shapes); XLA wants static shapes, so we pad every batch to
+``hps.max_enc_steps`` (or an explicit bucket length) and rely on the padding
+mask.  Likewise the reference's dynamic per-batch ``max_art_oovs``
+(batcher.py:181) becomes the static ``hps.max_oov_buckets`` budget: OOV ids
+at or beyond ``vocab_size + max_oov_buckets`` are clamped back to UNK in
+both the extended encoder input and the target, which keeps every array id
+inside the static extended vocabulary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data import oov as oov_lib
+from textsummarization_on_flink_tpu.data.vocab import (
+    PAD_ID,
+    START_DECODING,
+    STOP_DECODING,
+    Vocab,
+)
+
+
+def get_dec_inp_targ_seqs(sequence: Sequence[int], max_len: int, start_id: int,
+                          stop_id: int) -> Tuple[List[int], List[int]]:
+    """Decoder input starts with START; target ends with STOP unless
+    truncated (batcher.py:84-105 semantics)."""
+    inp = [start_id] + list(sequence)
+    target = list(sequence)
+    if len(inp) > max_len:
+        inp = inp[:max_len]
+        target = target[:max_len]  # no end token when truncated
+    else:
+        target.append(stop_id)
+    assert len(inp) == len(target)
+    return inp, target
+
+
+@dataclasses.dataclass
+class SummaryExample:
+    """One tokenized/truncated article-abstract pair (batcher.py:33-122)."""
+
+    enc_input: List[int]
+    enc_len: int
+    dec_input: List[int]
+    target: List[int]
+    dec_len: int
+    enc_input_extend_vocab: List[int]
+    article_oovs: List[str]
+    original_article: str
+    original_abstract: str
+    original_abstract_sents: List[str]
+    uuid: str = ""
+    reference: str = ""  # passthrough column for streaming inference
+
+    @classmethod
+    def build(cls, article: str, abstract_sentences: Sequence[str], vocab: Vocab,
+              hps: HParams, uuid: str = "", reference: str = "") -> "SummaryExample":
+        start_id = vocab.word2id(START_DECODING)
+        stop_id = vocab.word2id(STOP_DECODING)
+
+        article_words = article.split()
+        if len(article_words) > hps.max_enc_steps:
+            article_words = article_words[: hps.max_enc_steps]
+        enc_len = len(article_words)
+        enc_input = [vocab.word2id(w) for w in article_words]
+
+        abstract = " ".join(abstract_sentences)
+        abstract_words = abstract.split()
+        abs_ids = [vocab.word2id(w) for w in abstract_words]
+        dec_input, target = get_dec_inp_targ_seqs(
+            abs_ids, hps.max_dec_steps, start_id, stop_id)
+
+        if hps.pointer_gen:
+            enc_input_extend_vocab, article_oovs = oov_lib.article2ids(
+                article_words, vocab)
+            abs_ids_extend_vocab = oov_lib.abstract2ids(
+                abstract_words, vocab, article_oovs)
+            _, target = get_dec_inp_targ_seqs(
+                abs_ids_extend_vocab, hps.max_dec_steps, start_id, stop_id)
+        else:
+            enc_input_extend_vocab, article_oovs = list(enc_input), []
+
+        return cls(
+            enc_input=enc_input,
+            enc_len=enc_len,
+            dec_input=dec_input,
+            target=target,
+            dec_len=len(dec_input),
+            enc_input_extend_vocab=enc_input_extend_vocab,
+            article_oovs=article_oovs,
+            original_article=article,
+            original_abstract=abstract,
+            original_abstract_sents=list(abstract_sentences),
+            uuid=uuid,
+            reference=reference,
+        )
+
+
+class Batch:
+    """Static-shape numpy batch (batcher.py:125-219 semantics, XLA shapes).
+
+    Arrays:
+      enc_batch                (B, enc_steps) int32, UNK-mapped ids
+      enc_lens                 (B,)           int32
+      enc_padding_mask         (B, enc_steps) float32
+      enc_batch_extend_vocab   (B, enc_steps) int32, temp OOV ids (clamped)
+      dec_batch                (B, dec_steps) int32
+      target_batch             (B, dec_steps) int32 (extended ids, clamped)
+      dec_padding_mask         (B, dec_steps) float32
+    """
+
+    def __init__(self, example_list: Sequence[SummaryExample], hps: HParams,
+                 vocab: Vocab, enc_steps: Optional[int] = None):
+        if len(example_list) != hps.batch_size:
+            raise ValueError(
+                f"expected {hps.batch_size} examples, got {len(example_list)}")
+        self.pad_id = PAD_ID
+        B = hps.batch_size
+        T_enc = enc_steps if enc_steps is not None else hps.max_enc_steps
+        T_dec = hps.max_dec_steps
+        vsize = vocab.size()
+        oov_limit = vsize + hps.max_oov_buckets
+        unk = 0
+
+        self.enc_batch = np.full((B, T_enc), self.pad_id, dtype=np.int32)
+        self.enc_lens = np.zeros((B,), dtype=np.int32)
+        self.enc_padding_mask = np.zeros((B, T_enc), dtype=np.float32)
+        self.enc_batch_extend_vocab = np.full((B, T_enc), self.pad_id, dtype=np.int32)
+        self.dec_batch = np.full((B, T_dec), self.pad_id, dtype=np.int32)
+        self.target_batch = np.full((B, T_dec), self.pad_id, dtype=np.int32)
+        self.dec_padding_mask = np.zeros((B, T_dec), dtype=np.float32)
+
+        for i, ex in enumerate(example_list):
+            L = min(ex.enc_len, T_enc)
+            self.enc_batch[i, :L] = ex.enc_input[:L]
+            self.enc_lens[i] = L
+            self.enc_padding_mask[i, :L] = 1.0
+            ext = np.asarray(ex.enc_input_extend_vocab[:L], dtype=np.int32)
+            ext = np.where(ext >= oov_limit, unk, ext)  # static OOV budget
+            self.enc_batch_extend_vocab[i, :L] = ext
+            D = min(ex.dec_len, T_dec)
+            self.dec_batch[i, :D] = ex.dec_input[:D]
+            tgt = np.asarray(ex.target[:D], dtype=np.int32)
+            tgt = np.where(tgt >= oov_limit, unk, tgt)
+            self.target_batch[i, :D] = tgt
+            self.dec_padding_mask[i, :D] = 1.0
+
+        # max over batch of (clamped) in-article OOV counts — informational,
+        # the model always uses the static budget
+        self.max_art_oovs = max(
+            (min(len(ex.article_oovs), hps.max_oov_buckets) for ex in example_list),
+            default=0)
+        self.art_oovs = [ex.article_oovs for ex in example_list]
+        self.original_articles = [ex.original_article for ex in example_list]
+        self.original_abstracts = [ex.original_abstract for ex in example_list]
+        self.original_abstracts_sents = [
+            ex.original_abstract_sents for ex in example_list]
+        self.uuids = [ex.uuid for ex in example_list]
+        self.references = [ex.reference for ex in example_list]
+
+    def as_arrays(self) -> Dict[str, np.ndarray]:
+        """The device-feedable dict (everything static-shape)."""
+        return {
+            "enc_batch": self.enc_batch,
+            "enc_lens": self.enc_lens,
+            "enc_padding_mask": self.enc_padding_mask,
+            "enc_batch_extend_vocab": self.enc_batch_extend_vocab,
+            "dec_batch": self.dec_batch,
+            "target_batch": self.target_batch,
+            "dec_padding_mask": self.dec_padding_mask,
+        }

@@ -8,7 +8,6 @@ signed length followed by that many bytes of serialized tf.Example proto.
 from __future__ import annotations
 
 import glob
-import os
 import random
 import struct
 from typing import Iterable, Iterator, List, Optional

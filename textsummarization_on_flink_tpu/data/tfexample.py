@@ -157,6 +157,8 @@ def _decode_scalar_list(buf: bytes, kind: str) -> FeatureValue:
             values.append(val)
         elif kind == "float":
             if wire == _WIRE_LEN:  # packed
+                if len(val) % 4 != 0:
+                    raise ValueError("truncated packed float list")
                 values.extend(struct.unpack(f"<{len(val) // 4}f", val))
             elif wire == _WIRE_I32:
                 values.append(struct.unpack("<f", val)[0])
@@ -243,6 +245,10 @@ class Example:
                             kind_values = _decode_scalar_list(v4, "int64")
                     ex.features[key] = kind_values
         return ex
+
+    # Mutable (set_* mutate in place), hence deliberately unhashable;
+    # dedup on ex.serialize() bytes instead.
+    __hash__ = None  # type: ignore[assignment]
 
     def __eq__(self, other: object) -> bool:
         return isinstance(other, Example) and self.features == other.features

@@ -1,0 +1,1 @@
+"""Model families: pointer-generator (LSTM seq2seq) and transformer."""

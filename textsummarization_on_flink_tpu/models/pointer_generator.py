@@ -1,0 +1,331 @@
+"""Pointer-generator seq2seq model (See et al. 2017), TPU-native.
+
+Functional JAX re-design of the reference SummarizationModel
+(/root/reference/src/main/python/pointer-generator/model.py) and
+attention_decoder (attention_decoder.py).  Differences from the reference
+are architectural, not semantic:
+
+  * the 100-step Python-unrolled decoder graph (model.py:214,
+    attention_decoder.py:141-174) is a single `lax.scan`;
+  * training never materializes the extended-vocab final distribution
+    (model.py:162-183); the gold-token probability is computed directly
+    (see ops/losses.gold_mixture_prob);
+  * the in-article OOV budget is static (`hps.max_oov_buckets`) instead of
+    the dynamic per-batch `max_art_oovs` placeholder (model.py:45);
+  * decode-time single-step semantics (initial_state_attention=True,
+    attention_decoder.py:138-160) are preserved exactly, including the
+    quirk that the previous step's attention is recomputed to update
+    coverage while the current step's attention does not update it.
+
+Parameter tree field names mirror the TF1 variable layout so checkpoint
+import is a pure renaming exercise (checkpoint/tf1_import.py).
+
+All public functions are pure and jittable; `hps` is static.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.ops import attention as attn_ops
+from textsummarization_on_flink_tpu.ops import losses as loss_ops
+from textsummarization_on_flink_tpu.ops import lstm as lstm_ops
+
+Array = jax.Array
+Params = Dict[str, Any]
+
+
+class EncoderOutput(NamedTuple):
+    enc_states: Array  # [B, T_enc, 2H]
+    enc_features: Array  # precomputed W_h h_i, [B, T_enc, 2H]
+    dec_in_state: Tuple[Array, Array]  # (c, h) each [B, H]
+
+
+class DecodeStepOutput(NamedTuple):
+    topk_ids: Array  # [B, 2*beam]
+    topk_log_probs: Array  # [B, 2*beam]
+    state: Tuple[Array, Array]  # new (c, h)
+    attn_dist: Array  # [B, T_enc]
+    p_gen: Array  # [B]
+    coverage: Array  # [B, T_enc] updated coverage (zeros if coverage off)
+
+
+class TrainOutput(NamedTuple):
+    loss: Array  # NLL (the reference's self._loss)
+    coverage_loss: Array  # 0.0 when coverage off
+    total_loss: Array  # loss + cov_loss_wt * coverage_loss
+    attn_dists: Array  # [B, T_dec, T_enc] (for inspection/attn-vis)
+    p_gens: Array  # [B, T_dec]
+
+
+# --------------------------------------------------------------------------
+# Initialization (model.py:204-231 initializer choices)
+# --------------------------------------------------------------------------
+
+def _trunc_normal(key: Array, shape, std: float) -> Array:
+    return std * jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+
+
+def _uniform(key: Array, shape, mag: float) -> Array:
+    return jax.random.uniform(key, shape, jnp.float32, -mag, mag)
+
+
+def _glorot(key: Array, shape) -> Array:
+    if len(shape) == 1:
+        fan_in = fan_out = shape[0]
+    else:
+        fan_in, fan_out = shape[-2], shape[-1]
+    limit = (6.0 / (fan_in + fan_out)) ** 0.5
+    return jax.random.uniform(key, shape, jnp.float32, -limit, limit)
+
+
+def init_params(hps: HParams, vsize: int, key: Array) -> Params:
+    """Build the parameter pytree. Names map 1:1 onto the TF1 checkpoint
+    variable layout (see checkpoint/tf1_import.py for the exact mapping)."""
+    H, E = hps.hidden_dim, hps.emb_dim
+    D = 2 * H  # attention vector size == encoder state size (attention_decoder.py:63)
+    keys = iter(jax.random.split(key, 24))
+    tn = hps.trunc_norm_init_std
+    mag = hps.rand_unif_init_mag
+
+    params: Params = {
+        "embedding": _trunc_normal(next(keys), (vsize, E), tn),
+        "encoder": {
+            "fw": {"kernel": _uniform(next(keys), (E + H, 4 * H), mag),
+                   "bias": jnp.zeros((4 * H,), jnp.float32)},
+            "bw": {"kernel": _uniform(next(keys), (E + H, 4 * H), mag),
+                   "bias": jnp.zeros((4 * H,), jnp.float32)},
+        },
+        "reduce": {
+            "w_reduce_c": _trunc_normal(next(keys), (2 * H, H), tn),
+            "w_reduce_h": _trunc_normal(next(keys), (2 * H, H), tn),
+            "bias_reduce_c": _trunc_normal(next(keys), (H,), tn),
+            "bias_reduce_h": _trunc_normal(next(keys), (H,), tn),
+        },
+        "decoder": {
+            "cell": {"kernel": _uniform(next(keys), (E + H, 4 * H), mag),
+                     "bias": jnp.zeros((4 * H,), jnp.float32)},
+            "attention": {
+                "W_h": _glorot(next(keys), (D, D)),
+                "v": _glorot(next(keys), (D,)),
+                "w_c": _glorot(next(keys), (D,)),
+                "linear_kernel": _glorot(next(keys), (2 * H, D)),
+                "linear_bias": jnp.zeros((D,), jnp.float32),
+            },
+            "input_linear": {"kernel": _glorot(next(keys), (E + D, E)),
+                             "bias": jnp.zeros((E,), jnp.float32)},
+            "pgen_linear": {"kernel": _glorot(next(keys), (D + H + H + E, 1)),
+                            "bias": jnp.zeros((1,), jnp.float32)},
+            "output_linear": {"kernel": _glorot(next(keys), (H + D, H)),
+                              "bias": jnp.zeros((H,), jnp.float32)},
+        },
+        "output_projection": {
+            "w": _trunc_normal(next(keys), (H, vsize), tn),
+            "v": _trunc_normal(next(keys), (vsize,), tn),
+        },
+    }
+    return params
+
+
+def add_coverage_params(params: Params, key: Array) -> Params:
+    """Fresh w_c for coverage conversion (run_summarization.py:157-178)."""
+    new = jax.tree_util.tree_map(lambda x: x, params)  # shallow-ish copy
+    D = new["decoder"]["attention"]["W_h"].shape[0]
+    new["decoder"]["attention"]["w_c"] = _glorot(key, (D,))
+    return new
+
+
+# --------------------------------------------------------------------------
+# Forward pieces
+# --------------------------------------------------------------------------
+
+def _linear(p: Dict[str, Array], *args: Array) -> Array:
+    """attention_decoder.py:184-228 `linear`: concat args, matmul, bias."""
+    x = jnp.concatenate(args, axis=-1) if len(args) > 1 else args[0]
+    return x @ p["kernel"] + p["bias"]
+
+
+def _cast(hps: HParams, x: Array) -> Array:
+    return x.astype(jnp.bfloat16) if hps.compute_dtype == "bfloat16" else x
+
+
+def encode(params: Params, hps: HParams, enc_batch: Array, enc_lens: Array,
+           enc_padding_mask: Array) -> EncoderOutput:
+    """Embed + biLSTM + state reduction (model.py:210-221)."""
+    emb = params["embedding"][enc_batch]  # [B, T, E]
+    emb = _cast(hps, emb)
+    enc_states, fw_st, bw_st = lstm_ops.bidirectional_encoder(
+        params["encoder"]["fw"], params["encoder"]["bw"], emb, enc_lens,
+        enc_padding_mask)
+    enc_states = enc_states.astype(jnp.float32)
+    # _reduce_states (model.py:97-121): ReLU linear from fw||bw to H
+    r = params["reduce"]
+    old_c = jnp.concatenate([fw_st[0], bw_st[0]], axis=-1)
+    old_h = jnp.concatenate([fw_st[1], bw_st[1]], axis=-1)
+    new_c = jax.nn.relu(old_c @ r["w_reduce_c"] + r["bias_reduce_c"])
+    new_h = jax.nn.relu(old_h @ r["w_reduce_h"] + r["bias_reduce_h"])
+    enc_feats = attn_ops.encoder_features(
+        params["decoder"]["attention"], enc_states)
+    return EncoderOutput(enc_states, enc_feats, (new_c, new_h))
+
+
+def _decoder_core(params: Params, hps: HParams, enc: EncoderOutput,
+                  enc_padding_mask: Array, state: Tuple[Array, Array],
+                  context: Array, coverage: Array, inp_emb: Array,
+                  ) -> Dict[str, Array]:
+    """One train-mode decoder step (attention_decoder.py:141-174):
+    merge input+context -> cell -> attention (updates coverage) -> p_gen
+    -> output projection input.  coverage always flows; with coverage off
+    it is simply unused by the attention energies."""
+    dp = params["decoder"]
+    x = _linear(dp["input_linear"], inp_emb, context)
+    cell_out, new_state = lstm_ops.lstm_cell(dp["cell"], x, state)
+    new_context, attn_dist, new_cov = attn_ops.attend(
+        dp["attention"], enc.enc_states, enc.enc_features, enc_padding_mask,
+        new_state, coverage if hps.coverage else None, hps.coverage)
+    if new_cov is None:
+        new_cov = coverage
+    p_gen = jax.nn.sigmoid(
+        _linear(dp["pgen_linear"], new_context, new_state[0], new_state[1], x)
+    )[:, 0]
+    output = _linear(dp["output_linear"], cell_out, new_context)
+    return dict(x=x, state=new_state, context=new_context, attn_dist=attn_dist,
+                coverage=new_cov, p_gen=p_gen, output=output)
+
+
+def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
+                  ) -> TrainOutput:
+    """Full training/eval forward pass: scan the decoder over T_dec steps,
+    computing the masked NLL and coverage loss in-scan (model.py:199-277
+    semantics; per-step [B, V] projection keeps HBM use flat)."""
+    B = arrays["enc_batch"].shape[0]
+    T_enc = arrays["enc_batch"].shape[1]
+    enc = encode(params, hps, arrays["enc_batch"], arrays["enc_lens"],
+                 arrays["enc_padding_mask"])
+    emb_dec = params["embedding"][arrays["dec_batch"]]  # [B, T_dec, E]
+    w = params["output_projection"]["w"]
+    v = params["output_projection"]["v"]
+
+    def step(carry, xs):
+        state, context, coverage = carry
+        inp_emb, target, ext_ids_unused = xs
+        res = _decoder_core(params, hps, enc, arrays["enc_padding_mask"],
+                            state, context, coverage, inp_emb)
+        vocab_scores = res["output"] @ w + v  # [B, V]
+        vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
+        if hps.pointer_gen:
+            gold = loss_ops.gold_mixture_prob(
+                vocab_dist, res["attn_dist"], res["p_gen"], target,
+                arrays["enc_batch_extend_vocab"])
+            nll = -jnp.log(gold)
+        else:
+            nll = -jnp.take_along_axis(
+                jax.nn.log_softmax(vocab_scores, axis=-1),
+                target[:, None], axis=1)[:, 0]
+        covloss = jnp.sum(jnp.minimum(res["attn_dist"], coverage), axis=1)
+        return ((res["state"], res["context"], res["coverage"]),
+                (nll, covloss, res["attn_dist"], res["p_gen"]))
+
+    D = enc.enc_states.shape[-1]
+    init = (enc.dec_in_state, jnp.zeros((B, D), jnp.float32),
+            jnp.zeros((B, T_enc), jnp.float32))
+    xs = (jnp.swapaxes(emb_dec, 0, 1),
+          jnp.swapaxes(arrays["target_batch"], 0, 1),
+          jnp.swapaxes(arrays["target_batch"], 0, 1))
+    _, (nlls, covlosses, attn_dists, p_gens) = jax.lax.scan(step, init, xs)
+
+    dec_mask = arrays["dec_padding_mask"]
+    nlls = jnp.swapaxes(nlls, 0, 1)  # [B, T_dec]
+    covlosses = jnp.swapaxes(covlosses, 0, 1)
+    if hps.pointer_gen:
+        loss = loss_ops.mask_and_avg(nlls, dec_mask)
+    else:
+        loss = jnp.sum(nlls * dec_mask) / jnp.sum(dec_mask)
+    if hps.coverage:
+        cov_loss = loss_ops.mask_and_avg(covlosses, dec_mask)
+    else:
+        cov_loss = jnp.zeros(())
+    total = loss + hps.cov_loss_wt * cov_loss
+    return TrainOutput(loss=loss, coverage_loss=cov_loss, total_loss=total,
+                       attn_dists=jnp.swapaxes(attn_dists, 0, 1),
+                       p_gens=jnp.swapaxes(p_gens, 0, 1))
+
+
+# --------------------------------------------------------------------------
+# Decode mode (beam search building blocks)
+# --------------------------------------------------------------------------
+
+def run_encoder(params: Params, hps: HParams, arrays: Dict[str, Array],
+                ) -> EncoderOutput:
+    """Beam-search encoder pass (model.py:347-364)."""
+    return encode(params, hps, arrays["enc_batch"], arrays["enc_lens"],
+                  arrays["enc_padding_mask"])
+
+
+def final_distribution(hps: HParams, vocab_dist: Array, attn_dist: Array,
+                       p_gen: Array, enc_batch_extend_vocab: Array) -> Array:
+    """Extended-vocab mixture distribution [B, V + max_oov_buckets]
+    (model.py:146-183), with the static OOV budget replacing the dynamic
+    max_art_oovs.  Used at decode time only."""
+    B, V = vocab_dist.shape
+    ext_V = V + hps.max_oov_buckets
+    weighted_vocab = p_gen[:, None] * vocab_dist
+    weighted_attn = (1.0 - p_gen)[:, None] * attn_dist  # [B, T_enc]
+    base = jnp.zeros((B, ext_V), vocab_dist.dtype)
+    base = base.at[:, :V].set(weighted_vocab)
+    b_idx = jnp.arange(B)[:, None].repeat(attn_dist.shape[1], axis=1)
+    return base.at[b_idx, enc_batch_extend_vocab].add(weighted_attn)
+
+
+def decode_onestep(params: Params, hps: HParams, enc: EncoderOutput,
+                   enc_padding_mask: Array, enc_batch_extend_vocab: Array,
+                   latest_tokens: Array, state: Tuple[Array, Array],
+                   prev_coverage: Array) -> DecodeStepOutput:
+    """One beam-search decoder step with the reference's decode-mode
+    (initial_state_attention=True) semantics, attention_decoder.py:138-160:
+
+      1. re-run attention at the PREVIOUS state to rebuild the previous
+         context vector and update coverage (this is the only place
+         coverage advances in decode mode);
+      2. merge input+context, step the cell;
+      3. attention at the new state WITHOUT updating coverage;
+      4. p_gen, output projection, pointer mixture, top-2*beam.
+
+    latest_tokens: [B] fixed-vocab ids (caller maps OOV->UNK,
+    beam_search.py:112); state: (c, h) [B, H]; prev_coverage: [B, T_enc].
+    """
+    dp = params["decoder"]
+    use_cov = hps.coverage
+    ctx_prev, _, cov = attn_ops.attend(
+        dp["attention"], enc.enc_states, enc.enc_features, enc_padding_mask,
+        state, prev_coverage if use_cov else None, use_cov)
+    if cov is None:
+        cov = prev_coverage
+    inp_emb = params["embedding"][latest_tokens]
+    x = _linear(dp["input_linear"], inp_emb, ctx_prev)
+    cell_out, new_state = lstm_ops.lstm_cell(dp["cell"], x, state)
+    context, attn_dist, _ = attn_ops.attend(
+        dp["attention"], enc.enc_states, enc.enc_features, enc_padding_mask,
+        new_state, cov if use_cov else None, use_cov)
+    p_gen = jax.nn.sigmoid(
+        _linear(dp["pgen_linear"], context, new_state[0], new_state[1], x))[:, 0]
+    output = _linear(dp["output_linear"], cell_out, context)
+    vocab_scores = output @ params["output_projection"]["w"] + \
+        params["output_projection"]["v"]
+    vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
+    if hps.pointer_gen:
+        final_dist = final_distribution(hps, vocab_dist, attn_dist, p_gen,
+                                        enc_batch_extend_vocab)
+    else:
+        final_dist = vocab_dist
+    k = 2 * hps.beam_size  # model.py:284 (batch_size==beam_size there)
+    topk_probs, topk_ids = jax.lax.top_k(final_dist, k)
+    return DecodeStepOutput(topk_ids=topk_ids,
+                            topk_log_probs=jnp.log(topk_probs),
+                            state=new_state, attn_dist=attn_dist, p_gen=p_gen,
+                            coverage=cov)

@@ -1,0 +1,3 @@
+"""Core numeric ops: LSTM scans, additive attention + coverage, pointer
+mixing, losses.  Plain XLA implementations here; Pallas TPU kernels live in
+``pallas_*`` modules with these as their correctness baseline."""

@@ -1,0 +1,91 @@
+"""LSTM cell + length-aware scans, TF1-compatible math.
+
+The reference encoder/decoder cells are `tf.contrib.rnn.LSTMCell`
+(model.py:90-92,138).  TF1's LSTMCell computes, with gate order
+[i, j, f, o] on the fused kernel and forget_bias=1.0:
+
+    z = [x, h] @ kernel + bias
+    i, j, f, o = split(z, 4)
+    c' = c * sigmoid(f + 1.0) + sigmoid(i) * tanh(j)
+    h' = tanh(c') * sigmoid(o)
+
+We keep that exact gate order and forget bias so a TF1 checkpoint's fused
+kernel/bias can be loaded verbatim.  The bidirectional encoder matches
+`tf.nn.bidirectional_dynamic_rnn` with sequence_length (model.py:92):
+outputs beyond each sequence's length are zeros and the carried state
+freezes at the last valid step; the backward direction runs over the
+length-aware reversed sequence (reverse_sequence semantics).
+
+Everything here is jit/scan-based: one `lax.scan` per direction, batched
+matmuls on the MXU, no Python-level step loops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+LSTMState = Tuple[Array, Array]  # (c, h)
+
+
+def lstm_cell(params: Dict[str, Array], x: Array, state: LSTMState,
+              forget_bias: float = 1.0) -> Tuple[Array, LSTMState]:
+    """One LSTM step. x: [B, I]; state: ([B, H], [B, H])."""
+    c, h = state
+    z = jnp.concatenate([x, h], axis=-1) @ params["kernel"] + params["bias"]
+    i, j, f, o = jnp.split(z, 4, axis=-1)
+    new_c = c * jax.nn.sigmoid(f + forget_bias) + jax.nn.sigmoid(i) * jnp.tanh(j)
+    new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+    return new_h, (new_c, new_h)
+
+
+def unidirectional_scan(params: Dict[str, Array], inputs: Array, mask: Array,
+                        init_state: LSTMState) -> Tuple[Array, LSTMState]:
+    """Run an LSTM over time with dynamic_rnn length semantics.
+
+    inputs: [B, T, I]; mask: [B, T] (1.0 for valid steps).
+    Returns outputs [B, T, H] (zeroed past each length) and the final state
+    (frozen at each sequence's last valid step).
+    """
+
+    def step(state, xm):
+        x, m = xm
+        m = m[:, None]
+        out, (new_c, new_h) = lstm_cell(params, x, state)
+        c = jnp.where(m > 0, new_c, state[0])
+        h = jnp.where(m > 0, new_h, state[1])
+        return (c, h), out * m
+
+    xs = (jnp.swapaxes(inputs, 0, 1), jnp.swapaxes(mask, 0, 1))
+    final_state, outs = jax.lax.scan(step, init_state, xs)
+    return jnp.swapaxes(outs, 0, 1), final_state
+
+
+def reverse_sequence(x: Array, lens: Array) -> Array:
+    """tf.reverse_sequence along axis 1: reverse only the first `lens[b]`
+    entries of each row; padding stays in place."""
+    T = x.shape[1]
+    t = jnp.arange(T)[None, :]  # [1, T]
+    idx = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+    return jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def bidirectional_encoder(fw_params: Dict[str, Array], bw_params: Dict[str, Array],
+                          inputs: Array, lens: Array, mask: Array,
+                          ) -> Tuple[Array, LSTMState, LSTMState]:
+    """bidirectional_dynamic_rnn parity (model.py:76-94).
+
+    Returns (outputs [B, T, 2H] fw||bw concat, fw_state, bw_state).
+    """
+    B = inputs.shape[0]
+    H = fw_params["kernel"].shape[1] // 4
+    zero = (jnp.zeros((B, H), inputs.dtype), jnp.zeros((B, H), inputs.dtype))
+    fw_out, fw_state = unidirectional_scan(fw_params, inputs, mask, zero)
+    rev_inputs = reverse_sequence(inputs, lens)
+    bw_out_rev, bw_state = unidirectional_scan(bw_params, rev_inputs, mask, zero)
+    bw_out = reverse_sequence(bw_out_rev, lens)
+    return jnp.concatenate([fw_out, bw_out], axis=-1), fw_state, bw_state

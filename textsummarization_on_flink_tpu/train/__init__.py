@@ -1,0 +1,1 @@
+"""Training: optimizer, jitted/pjitted train step, loops, eval."""

@@ -1,0 +1,55 @@
+"""Adagrad + global-norm clipping, exact TF1 semantics.
+
+The reference trains with `tf.train.AdagradOptimizer(lr,
+initial_accumulator_value=0.1)` after `clip_by_global_norm(grads, 2.0)`
+(model.py:288-305).  TF1 Adagrad (no epsilon):
+
+    accum += g^2
+    param -= lr * g / sqrt(accum)
+
+optax's adagrad adds an eps inside the rsqrt, so we hand-roll the exact
+update as an optax-style GradientTransformation.  The global-norm clip
+matches tf.clip_by_global_norm: scale all grads by
+min(1, max_norm / global_norm).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+PyTree = Any
+
+
+class AdagradState(NamedTuple):
+    accumulators: PyTree
+
+
+def global_norm(tree: PyTree) -> Array:
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x))
+                        for x in jax.tree_util.tree_leaves(tree)))
+
+
+def clip_by_global_norm(tree: PyTree, max_norm: float) -> Tuple[PyTree, Array]:
+    """tf.clip_by_global_norm parity: returns (clipped, pre-clip norm)."""
+    norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-30))
+    return jax.tree_util.tree_map(lambda x: x * scale, tree), norm
+
+
+def adagrad_init(params: PyTree, initial_accumulator_value: float) -> AdagradState:
+    return AdagradState(accumulators=jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, initial_accumulator_value), params))
+
+
+def adagrad_update(grads: PyTree, state: AdagradState, params: PyTree,
+                   lr: float) -> Tuple[PyTree, AdagradState]:
+    """Returns (new_params, new_state)."""
+    new_acc = jax.tree_util.tree_map(
+        lambda a, g: a + jnp.square(g), state.accumulators, grads)
+    new_params = jax.tree_util.tree_map(
+        lambda p, g, a: p - lr * g * jax.lax.rsqrt(a), params, grads, new_acc)
+    return new_params, AdagradState(accumulators=new_acc)
