@@ -1,0 +1,83 @@
+"""Benchmark harness: pointer-generator training throughput on TPU.
+
+The reference publishes no numbers (BASELINE.md); its train loop is
+instrumented but CPU-bound TF1 (graph pinned to /cpu:0, model.py:313).  The
+operative anchor is the See et al. setup the pretrained checkpoint came
+from: 230k iterations at batch 16 in "3 days 4 hours" on a single Tesla
+K40m GPU (pointer-generator README) ≈ 0.84 steps/s ≈ 13.5 samples/sec —
+that is the `vs_baseline` denominator.
+
+Prints ONE JSON line:
+  {"metric": "train_samples_per_sec", "value": N, "unit": "samples/s",
+   "vs_baseline": N}
+
+Config: the reference default training scale (hidden 256, emb 128,
+vocab 50k, enc 400, dec 100, batch 16, Adagrad lr .15) with bf16 MXU
+compute.  Synthetic token data (dataset IO is benched separately in
+tests); timing excludes compilation (warmup steps) and uses
+block_until_ready.
+
+Env overrides: BENCH_STEPS (default 20), BENCH_WARMUP (3), BENCH_BATCH
+(16 — per chip).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from textsummarization_on_flink_tpu.config import HParams
+    from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+    from __graft_entry__ import _example_arrays
+
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
+
+    hps = HParams(batch_size=batch, compute_dtype="bfloat16")
+
+    state = trainer_lib.init_train_state(hps, hps.vocab_size, seed=0)
+    step_fn = jax.jit(trainer_lib.make_train_step(hps), donate_argnums=0)
+    arrays = _example_arrays(hps, np.random.RandomState(0))
+    arrays = jax.device_put(arrays)
+
+    for _ in range(warmup):
+        state, metrics = step_fn(state, arrays)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, arrays)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    loss = float(metrics.loss)
+    if not np.isfinite(loss):
+        print(json.dumps({"metric": "train_samples_per_sec", "value": 0.0,
+                          "unit": "samples/s", "vs_baseline": 0.0,
+                          "error": f"non-finite loss {loss}"}))
+        sys.exit(1)
+
+    # the un-sharded jit runs on exactly one chip, so the measured
+    # throughput IS the per-chip number
+    samples_per_sec = steps * batch / dt
+    per_chip = samples_per_sec
+    baseline = 13.5  # single-GPU K40m anchor, see module docstring
+    print(json.dumps({
+        "metric": "train_samples_per_sec",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(per_chip / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,198 @@
+"""Checkpoint lifecycle tests: retention, best-model, surgery, inspector,
+TF1 import mapping."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from textsummarization_on_flink_tpu.checkpoint import (
+    BestModelSaver,
+    Checkpointer,
+    convert_to_coverage_model,
+    latest_checkpoint,
+    load_ckpt,
+    restore_best_model,
+)
+from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
+from textsummarization_on_flink_tpu.checkpoint.inspect import inspect_arrays
+from textsummarization_on_flink_tpu.checkpoint import tf1_import
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.models import pointer_generator as pg
+from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+
+
+def tiny_hps(**kw):
+    base = dict(hidden_dim=8, emb_dim=6, batch_size=4, max_enc_steps=10,
+                max_dec_steps=5, beam_size=2, min_dec_steps=2, vocab_size=32,
+                max_oov_buckets=4)
+    base.update(kw)
+    return HParams(**base)
+
+
+@pytest.fixture()
+def state():
+    hps = tiny_hps()
+    return trainer_lib.init_train_state(hps, hps.vocab_size, seed=3)
+
+
+def test_save_restore_roundtrip(tmp_path, state):
+    ck = Checkpointer(str(tmp_path), hps=tiny_hps())
+    path = ck.save(state)
+    assert os.path.exists(path)
+    restored = ck.restore()
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_retention_keeps_three(tmp_path, state):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    for step in range(5):
+        s = state._replace(step=np.asarray(step, np.int32))
+        ck.save(s)
+    files = sorted(f for f in os.listdir(tmp_path) if f.endswith(".npz"))
+    assert files == ["model.ckpt-2.npz", "model.ckpt-3.npz", "model.ckpt-4.npz"]
+    assert latest_checkpoint(str(tmp_path)).endswith("model.ckpt-4.npz")
+
+
+def test_load_ckpt_raises_when_empty(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_ckpt(str(tmp_path), max_retries=1, retry_secs=0.01)
+
+
+def test_load_ckpt_finds_latest(tmp_path, state):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(state._replace(step=np.asarray(7, np.int32)))
+    path, flat = load_ckpt(str(tmp_path), max_retries=0)
+    assert path.endswith("model.ckpt-7.npz")
+    assert "params/embedding" in flat
+
+
+def test_best_model_saver_keeps_one(tmp_path, state):
+    bs = BestModelSaver(str(tmp_path))
+    bs(state.params, 3.0, 10)
+    bs(state.params, 2.5, 20)
+    files = [f for f in os.listdir(tmp_path) if f.startswith("bestmodel")]
+    assert files == ["bestmodel-20.npz"]
+    assert latest_checkpoint(
+        str(tmp_path), ckpt_lib.BEST_INDEX_FILE).endswith("bestmodel-20.npz")
+
+
+def test_convert_to_coverage_model(tmp_path, state):
+    hps = tiny_hps()
+    ck = Checkpointer(str(tmp_path))
+    ck.save(state)
+    out = convert_to_coverage_model(str(tmp_path), hps, seed=9)
+    assert out.endswith("_cov_init.npz")
+    new_state = ckpt_lib.arrays_to_state(ckpt_lib.load_arrays(out))
+    old_wc = np.asarray(state.params["decoder"]["attention"]["w_c"])
+    new_wc = np.asarray(new_state.params["decoder"]["attention"]["w_c"])
+    assert not np.allclose(old_wc, new_wc)  # freshly initialized
+    np.testing.assert_array_equal(
+        np.asarray(new_state.params["embedding"]),
+        np.asarray(state.params["embedding"]))
+    # fresh accumulator for w_c only
+    np.testing.assert_allclose(
+        np.asarray(new_state.opt_state.accumulators["decoder"]["attention"]["w_c"]),
+        hps.adagrad_init_acc)
+    # the index now points at the converted checkpoint
+    assert latest_checkpoint(str(tmp_path)) == out
+
+
+def test_restore_best_model(tmp_path, state):
+    hps = tiny_hps()
+    eval_dir = str(tmp_path / "eval")
+    train_dir = str(tmp_path / "train")
+    os.makedirs(train_dir)
+    BestModelSaver(eval_dir)(state.params, 1.0, 42)
+    out = restore_best_model(eval_dir, train_dir, hps)
+    rs = ckpt_lib.arrays_to_state(ckpt_lib.load_arrays(out))
+    np.testing.assert_array_equal(np.asarray(rs.params["embedding"]),
+                                  np.asarray(state.params["embedding"]))
+    np.testing.assert_allclose(
+        np.asarray(rs.opt_state.accumulators["embedding"]),
+        hps.adagrad_init_acc)
+    assert int(rs.step) == 42
+
+
+def test_inspect_arrays_reports_nans():
+    flat = {"good": np.ones(3), "half": np.array([1.0, np.nan]),
+            "bad": np.full(2, np.inf), "ints": np.arange(3)}
+    rep = inspect_arrays(flat)
+    assert rep["finite"] == ["good", "ints"]
+    assert rep["some_infnan"] == ["half"]
+    assert rep["all_infnan"] == ["bad"]
+
+
+# ---- TF1 import ----
+
+def _fake_tf1_vars(hps, vsize, include_coverage=True):
+    H, E, D = hps.hidden_dim, hps.emb_dim, 2 * hps.hidden_dim
+    rng = np.random.RandomState(0)
+    dec = tf1_import._DEC
+    shapes = {
+        "seq2seq/embedding/embedding": (vsize, E),
+        "seq2seq/encoder/bidirectional_rnn/fw/lstm_cell/kernel": (E + H, 4 * H),
+        "seq2seq/encoder/bidirectional_rnn/fw/lstm_cell/bias": (4 * H,),
+        "seq2seq/encoder/bidirectional_rnn/bw/lstm_cell/kernel": (E + H, 4 * H),
+        "seq2seq/encoder/bidirectional_rnn/bw/lstm_cell/bias": (4 * H,),
+        "seq2seq/reduce_final_st/w_reduce_c": (D, H),
+        "seq2seq/reduce_final_st/w_reduce_h": (D, H),
+        "seq2seq/reduce_final_st/bias_reduce_c": (H,),
+        "seq2seq/reduce_final_st/bias_reduce_h": (H,),
+        f"{dec}/W_h": (1, 1, D, D),
+        f"{dec}/v": (D,),
+        f"{dec}/Attention/Linear/Matrix": (D, D),
+        f"{dec}/Attention/Linear/Bias": (D,),
+        f"{dec}/Linear/Matrix": (E + D, E),
+        f"{dec}/Linear/Bias": (E,),
+        f"{dec}/lstm_cell/kernel": (E + H, 4 * H),
+        f"{dec}/lstm_cell/bias": (4 * H,),
+        f"{dec}/calculate_pgen/Linear/Matrix": (D + H + H + E, 1),
+        f"{dec}/calculate_pgen/Linear/Bias": (1,),
+        f"{dec}/AttnOutputProjection/Linear/Matrix": (H + D, H),
+        f"{dec}/AttnOutputProjection/Linear/Bias": (H,),
+        "seq2seq/output_projection/w": (H, vsize),
+        "seq2seq/output_projection/v": (vsize,),
+        "global_step": (),
+    }
+    if include_coverage:
+        shapes[f"{dec}/coverage/w_c"] = (1, 1, 1, D)
+    out = {n: np.asarray(rng.randn(*s), np.float32) for n, s in shapes.items()}
+    out["seq2seq/embedding/embedding/Adagrad"] = np.ones((vsize, E), np.float32)
+    return out
+
+
+def test_tf1_import_shapes_match_init(state):
+    hps = tiny_hps()
+    imported = tf1_import.import_tf1_arrays(
+        _fake_tf1_vars(hps, hps.vocab_size))
+    ours = state.params
+    imp_flat = ckpt_lib._flatten(imported)
+    our_flat = ckpt_lib._flatten(ours)
+    assert set(imp_flat) == set(our_flat)
+    for k in our_flat:
+        assert imp_flat[k].shape == our_flat[k].shape, k
+
+
+def test_tf1_import_runs_forward(state):
+    hps = tiny_hps(coverage=True)
+    params = tf1_import.import_tf1_arrays(_fake_tf1_vars(hps, hps.vocab_size))
+    from __graft_entry__ import _example_arrays
+    arrays = _example_arrays(hps, np.random.RandomState(1))
+    out = pg.forward_train(params, hps, arrays)
+    assert np.isfinite(float(out.total_loss))
+
+
+def test_tf1_import_missing_coverage_ok(state):
+    hps = tiny_hps()
+    params = tf1_import.import_tf1_arrays(
+        _fake_tf1_vars(hps, hps.vocab_size, include_coverage=False))
+    assert "w_c" not in params["decoder"]["attention"]
+
+
+def test_tf1_import_unmapped_raises():
+    with pytest.raises(KeyError):
+        tf1_import.import_tf1_arrays({"bogus/var": np.zeros(2)})

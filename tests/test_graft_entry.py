@@ -1,0 +1,28 @@
+"""Driver-hook smoke tests: entry() traces, dryrun_multichip executes."""
+
+import jax
+
+import __graft_entry__ as ge
+
+
+def test_entry_traces():
+    fn, args = ge.entry()
+    # Tracing (abstract evaluation) validates shapes/dtypes without paying
+    # the full XLA compile; the driver does the real compile check.
+    lowered = jax.jit(fn).lower(*args)
+    assert lowered is not None
+
+
+def test_dryrun_multichip_8():
+    ge.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_1():
+    ge.dryrun_multichip(1)
+
+
+def test_factor_mesh():
+    assert ge._factor_mesh(8) == (2, 2, 2)
+    assert ge._factor_mesh(4) == (1, 2, 2)
+    assert ge._factor_mesh(2) == (1, 2, 1)
+    assert ge._factor_mesh(1) == (1, 1, 1)

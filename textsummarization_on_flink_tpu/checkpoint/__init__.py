@@ -1,0 +1,8 @@
+from textsummarization_on_flink_tpu.checkpoint.checkpointer import (  # noqa: F401
+    BestModelSaver,
+    Checkpointer,
+    convert_to_coverage_model,
+    latest_checkpoint,
+    load_ckpt,
+    restore_best_model,
+)

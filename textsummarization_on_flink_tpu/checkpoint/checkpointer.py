@@ -1,0 +1,298 @@
+"""Checkpoint save/restore with the reference's full lifecycle semantics.
+
+Rebuilds the reference checkpoint story (SURVEY.md §5.4) without TF:
+
+  * 3-checkpoint retention + index file — `Saver(max_to_keep=3)` +
+    the `checkpoint` latest-file protocol
+    (/root/reference/src/main/python/pointer-generator/run_summarization.py:192,
+    train.py:68).
+  * best-model track with its own `checkpoint_best` index
+    (run_summarization.py:250-292).
+  * `load_ckpt` retry loop — decoders wait for trainers to produce a first
+    checkpoint (util.py:29-41: infinite 10s retries).
+  * checkpoint surgery: `convert_to_coverage_model`
+    (run_summarization.py:157-178) and `restore_best_model`
+    (run_summarization.py:132-154, which drops Adagrad accumulators).
+
+Format: one ``.npz`` per checkpoint holding every leaf of the TrainState
+pytree under its slash-joined key path (``params/decoder/attention/W_h``,
+``opt_state/accumulators/...``, ``step``), plus a small JSON sidecar of
+hparams for provenance.  Arrays are gathered to host before writing
+(multi-host callers save on the chief only, parallel/distributed.is_chief).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.train import optim
+from textsummarization_on_flink_tpu.train.trainer import TrainState
+
+log = logging.getLogger(__name__)
+
+PyTree = Any
+
+CKPT_PREFIX = "model.ckpt"
+INDEX_FILE = "checkpoint"  # latest-pointer file, tf.train.Saver protocol
+BEST_INDEX_FILE = "checkpoint_best"
+
+
+# --------------------------------------------------------------------------
+# Pytree <-> flat dict
+# --------------------------------------------------------------------------
+
+def _flatten(tree: PyTree, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Flatten nested dicts/NamedTuples to slash-joined keys."""
+    out: Dict[str, np.ndarray] = {}
+
+    def rec(node: Any, path: str) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                rec(v, f"{path}/{k}" if path else str(k))
+        elif hasattr(node, "_fields"):  # NamedTuple
+            for k in node._fields:
+                rec(getattr(node, k), f"{path}/{k}" if path else str(k))
+        else:
+            out[path] = np.asarray(jax.device_get(node))
+
+    rec(tree, prefix)
+    return out
+
+
+def _unflatten_dicts(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Rebuild a pure nested-dict tree from slash-joined keys."""
+    root: Dict[str, Any] = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return root
+
+
+def state_to_arrays(state: TrainState) -> Dict[str, np.ndarray]:
+    return _flatten(state)
+
+
+def arrays_to_state(flat: Dict[str, np.ndarray]) -> TrainState:
+    tree = _unflatten_dicts(flat)
+    step = tree.get("step", np.zeros((), np.int32))
+    params = tree["params"]
+    acc = tree.get("opt_state", {}).get("accumulators")
+    if acc is None:
+        acc = jax.tree_util.tree_map(lambda p: np.zeros_like(p), params)
+    return TrainState(params=params,
+                      opt_state=optim.AdagradState(accumulators=acc),
+                      step=np.asarray(step, np.int32))
+
+
+# --------------------------------------------------------------------------
+# Raw file IO
+# --------------------------------------------------------------------------
+
+def save_arrays(path: str, flat: Dict[str, np.ndarray]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, path)  # atomic publish; readers never see partial files
+
+
+def load_arrays(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _write_index(directory: str, ckpt_path: str, index_file: str) -> None:
+    tmp = os.path.join(directory, index_file + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"model_checkpoint_path": os.path.basename(ckpt_path)}, f)
+    os.replace(tmp, os.path.join(directory, index_file))
+
+
+def latest_checkpoint(directory: str, index_file: str = INDEX_FILE,
+                      ) -> Optional[str]:
+    """Resolve the newest checkpoint path via the index file (falling back
+    to a directory scan, like tf.train.latest_checkpoint)."""
+    idx = os.path.join(directory, index_file)
+    if os.path.exists(idx):
+        try:
+            with open(idx, "r", encoding="utf-8") as f:
+                name = json.load(f)["model_checkpoint_path"]
+            path = name if os.path.isabs(name) else os.path.join(directory, name)
+            if os.path.exists(path):
+                return path
+        except (json.JSONDecodeError, KeyError, OSError):
+            log.warning("unreadable checkpoint index %s; rescanning", idx)
+    pattern = os.path.join(directory, f"{CKPT_PREFIX}-*.npz")
+    found = sorted(glob.glob(pattern), key=_ckpt_step)
+    return found[-1] if found else None
+
+
+def _ckpt_step(path: str) -> Tuple[int, int]:
+    """Sort key: (step, is_surgery).  Surgery outputs
+    (`-<N>_cov_init.npz`, `-<N>_restored.npz`) carry their source step and
+    sort *after* the plain checkpoint of the same step (they are newer)."""
+    m = re.search(r"-(\d+)(_[a-z_]+)?\.npz$", path)
+    if not m:
+        return (-1, 0)
+    return (int(m.group(1)), 1 if m.group(2) else 0)
+
+
+def load_ckpt(directory: str, index_file: str = INDEX_FILE,
+              max_retries: Optional[int] = None, retry_secs: float = 10.0,
+              ) -> Tuple[str, Dict[str, np.ndarray]]:
+    """Load the latest checkpoint, retrying until one appears
+    (util.py:29-41: infinite 10s retry by default)."""
+    attempt = 0
+    while True:
+        path = latest_checkpoint(directory, index_file)
+        if path is not None:
+            try:
+                return path, load_arrays(path)
+            except (OSError, ValueError) as e:
+                log.info("Failed to load checkpoint from %s: %s", path, e)
+        attempt += 1
+        if max_retries is not None and attempt > max_retries:
+            raise FileNotFoundError(
+                f"no loadable checkpoint in {directory} after "
+                f"{max_retries} retries")
+        log.info("Failed to load checkpoint from %s. Sleeping %.0f secs...",
+                 directory, retry_secs)
+        time.sleep(retry_secs)
+
+
+# --------------------------------------------------------------------------
+# Checkpointer / BestModelSaver
+# --------------------------------------------------------------------------
+
+class Checkpointer:
+    """Rolling-retention trainer checkpoints (Saver(max_to_keep=3) parity)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 hps: Optional[HParams] = None):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        self.hps = hps
+        os.makedirs(directory, exist_ok=True)
+        if hps is not None:  # provenance sidecar, written once, atomically
+            tmp = os.path.join(directory, "hparams.json.tmp")
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(hps.to_json())
+            os.replace(tmp, os.path.join(directory, "hparams.json"))
+
+    def save(self, state: TrainState) -> str:
+        step = int(np.asarray(jax.device_get(state.step)))
+        path = os.path.join(self.directory, f"{CKPT_PREFIX}-{step}.npz")
+        save_arrays(path, state_to_arrays(state))
+        _write_index(self.directory, path, INDEX_FILE)
+        self._retain()
+        log.info("saved checkpoint %s", path)
+        return path
+
+    def _retain(self) -> None:
+        ckpts = sorted(
+            glob.glob(os.path.join(self.directory, f"{CKPT_PREFIX}-*.npz")),
+            key=_ckpt_step)
+        for old in ckpts[: max(0, len(ckpts) - self.max_to_keep)]:
+            try:
+                os.remove(old)
+                log.info("removed old checkpoint %s", old)
+            except OSError:
+                pass
+
+    def restore(self, path: Optional[str] = None) -> Optional[TrainState]:
+        path = path or latest_checkpoint(self.directory)
+        if path is None:
+            return None
+        return arrays_to_state(load_arrays(path))
+
+
+class BestModelSaver:
+    """Eval-side best-model track (run_summarization.py:250-292): keeps ONE
+    `bestmodel-<step>.npz` under eval_dir, indexed by `checkpoint_best`."""
+
+    def __init__(self, eval_dir: str):
+        self.eval_dir = eval_dir
+        os.makedirs(eval_dir, exist_ok=True)
+
+    def __call__(self, params: PyTree, running_avg_loss: float, step: int,
+                 ) -> str:
+        path = os.path.join(self.eval_dir, f"bestmodel-{step}.npz")
+        old = glob.glob(os.path.join(self.eval_dir, "bestmodel-*.npz"))
+        save_arrays(path, _flatten(params, "params"))
+        _write_index(self.eval_dir, path, BEST_INDEX_FILE)
+        for o in old:
+            if o != path:
+                try:
+                    os.remove(o)
+                except OSError:
+                    pass
+        log.info("saved best model (loss %.4f) to %s", running_avg_loss, path)
+        return path
+
+
+# --------------------------------------------------------------------------
+# Checkpoint surgery
+# --------------------------------------------------------------------------
+
+def convert_to_coverage_model(train_dir: str, hps: HParams,
+                              seed: int = 0) -> str:
+    """Add fresh coverage params to the latest non-coverage checkpoint and
+    save it as `<ckpt>_cov_init` (run_summarization.py:157-178 semantics:
+    restore non-coverage vars, init the new coverage vars, save-and-exit)."""
+    from textsummarization_on_flink_tpu.models import pointer_generator as pg
+
+    path = latest_checkpoint(train_dir)
+    if path is None:
+        raise FileNotFoundError(f"no checkpoint in {train_dir}")
+    state = arrays_to_state(load_arrays(path))
+    new_params = pg.add_coverage_params(state.params,
+                                        jax.random.PRNGKey(seed))
+    # fresh accumulator only for the new variable (others keep history)
+    new_acc = jax.tree_util.tree_map(lambda x: x, state.opt_state.accumulators)
+    new_acc["decoder"]["attention"]["w_c"] = np.full_like(
+        np.asarray(new_params["decoder"]["attention"]["w_c"]),
+        hps.adagrad_init_acc)
+    new_state = TrainState(params=new_params,
+                           opt_state=optim.AdagradState(accumulators=new_acc),
+                           step=state.step)
+    out = path[: -len(".npz")] + "_cov_init.npz"
+    save_arrays(out, state_to_arrays(new_state))
+    _write_index(train_dir, out, INDEX_FILE)
+    log.info("saved coverage-converted checkpoint %s", out)
+    return out
+
+
+def restore_best_model(eval_dir: str, train_dir: str, hps: HParams) -> str:
+    """Copy the eval best model into the train dir with FRESH Adagrad
+    accumulators (run_summarization.py:132-154 restores only non-Adagrad
+    variables), saved as `model.ckpt-<step>_restored.npz`."""
+    path = latest_checkpoint(eval_dir, BEST_INDEX_FILE)
+    if path is None:
+        raise FileNotFoundError(f"no best model in {eval_dir}")
+    flat = load_arrays(path)
+    params = _unflatten_dicts(flat)["params"]
+    acc = jax.tree_util.tree_map(
+        lambda p: np.full_like(p, hps.adagrad_init_acc), params)
+    m = re.search(r"-(\d+)\.npz$", path)
+    step = int(m.group(1)) if m else 0
+    state = TrainState(params=params,
+                       opt_state=optim.AdagradState(accumulators=acc),
+                       step=np.asarray(step, np.int32))
+    out = os.path.join(train_dir, f"{CKPT_PREFIX}-{step}_restored.npz")
+    save_arrays(out, state_to_arrays(state))
+    _write_index(train_dir, out, INDEX_FILE)
+    log.info("restored best model %s -> %s", path, out)
+    return out

@@ -1,0 +1,60 @@
+"""Checkpoint NaN/Inf inspector CLI.
+
+Behavior parity with /root/reference/src/main/python/pointer-generator/
+inspect_checkpoint.py:11-45: scan every tensor in a checkpoint, report
+which are finite / contain some non-finite / are entirely non-finite.
+
+Usage: python -m textsummarization_on_flink_tpu.checkpoint.inspect <file.npz>
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from textsummarization_on_flink_tpu.checkpoint.checkpointer import load_arrays
+
+
+def inspect_arrays(flat: Dict[str, np.ndarray]) -> Dict[str, List[str]]:
+    finite, some_bad, all_bad = [], [], []
+    for name in sorted(flat):
+        v = np.asarray(flat[name])
+        if not np.issubdtype(v.dtype, np.floating) and \
+                not np.issubdtype(v.dtype, np.complexfloating):
+            finite.append(name)
+            continue
+        bad = ~np.isfinite(v)
+        if not bad.any():
+            finite.append(name)
+        elif bad.all():
+            all_bad.append(name)
+        else:
+            some_bad.append(name)
+    return {"finite": finite, "some_infnan": some_bad, "all_infnan": all_bad}
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 1:
+        print("USAGE: python -m textsummarization_on_flink_tpu.checkpoint."
+              "inspect <checkpoint.npz>", file=sys.stderr)
+        return 2
+    flat = load_arrays(argv[0])
+    report = inspect_arrays(flat)
+    print(f"{len(flat)} tensors in {argv[0]}")
+    for name in report["finite"]:
+        print(f"  ok      {name}")
+    for name in report["some_infnan"]:
+        print(f"  SOMEBAD {name}  (contains some inf/nan)")
+    for name in report["all_infnan"]:
+        print(f"  ALLBAD  {name}  (entirely inf/nan)")
+    if not report["some_infnan"] and not report["all_infnan"]:
+        print("CHECK PASSED: checkpoint contains no inf/NaN values")
+        return 0
+    print("CHECK FAILED: checkpoint contains inf/NaN values")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

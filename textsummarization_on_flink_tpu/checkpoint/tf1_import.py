@@ -1,0 +1,124 @@
+"""TF1 pretrained-checkpoint importer (pretrained_model_tf1.2.1 layout).
+
+Maps the reference graph's TF1 variable names onto our parameter pytree so
+the published pointer-generator checkpoint can be served without
+retraining (SURVEY.md §7.4 item 3).  Names verified against the variable
+scopes in /root/reference/src/main/python/pointer-generator/model.py
+(seq2seq/embedding:210, encoder:89, reduce_final_st:108,
+output_projection:228) and attention_decoder.py (W_h:66, v:70,
+coverage/w_c:73, Attention/Linear:91+219, calculate_pgen:165,
+AttnOutputProjection:172); LSTM cells use the TF>=1.2 `lstm_cell/kernel`
+fused naming (noted in the pointer-generator README).
+
+Two entry points:
+  * `import_tf1_arrays(name->ndarray)`: pure numpy, no TF needed — feed it
+    from any tool that can read a TF bundle (including
+    `tf.train.load_checkpoint` on a machine that has TF).
+  * `import_tf1_checkpoint(path)`: convenience wrapper that uses
+    tensorflow if importable, else raises with instructions.
+
+Conv-shaped attention tensors are squeezed: W_h [1,1,2H,D] -> [2H,D],
+w_c [1,1,1,D] -> [D].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+PyTree = Any
+
+_DEC = "seq2seq/decoder/attention_decoder"
+
+# TF1 variable name -> (tree path tuple, squeeze)
+TF1_NAME_MAP: Dict[str, Any] = {
+    "seq2seq/embedding/embedding": (("embedding",), False),
+    "seq2seq/encoder/bidirectional_rnn/fw/lstm_cell/kernel":
+        (("encoder", "fw", "kernel"), False),
+    "seq2seq/encoder/bidirectional_rnn/fw/lstm_cell/bias":
+        (("encoder", "fw", "bias"), False),
+    "seq2seq/encoder/bidirectional_rnn/bw/lstm_cell/kernel":
+        (("encoder", "bw", "kernel"), False),
+    "seq2seq/encoder/bidirectional_rnn/bw/lstm_cell/bias":
+        (("encoder", "bw", "bias"), False),
+    "seq2seq/reduce_final_st/w_reduce_c": (("reduce", "w_reduce_c"), False),
+    "seq2seq/reduce_final_st/w_reduce_h": (("reduce", "w_reduce_h"), False),
+    "seq2seq/reduce_final_st/bias_reduce_c":
+        (("reduce", "bias_reduce_c"), False),
+    "seq2seq/reduce_final_st/bias_reduce_h":
+        (("reduce", "bias_reduce_h"), False),
+    f"{_DEC}/W_h": (("decoder", "attention", "W_h"), True),
+    f"{_DEC}/v": (("decoder", "attention", "v"), False),
+    f"{_DEC}/coverage/w_c": (("decoder", "attention", "w_c"), True),
+    f"{_DEC}/Attention/Linear/Matrix":
+        (("decoder", "attention", "linear_kernel"), False),
+    f"{_DEC}/Attention/Linear/Bias":
+        (("decoder", "attention", "linear_bias"), False),
+    f"{_DEC}/Linear/Matrix": (("decoder", "input_linear", "kernel"), False),
+    f"{_DEC}/Linear/Bias": (("decoder", "input_linear", "bias"), False),
+    f"{_DEC}/lstm_cell/kernel": (("decoder", "cell", "kernel"), False),
+    f"{_DEC}/lstm_cell/bias": (("decoder", "cell", "bias"), False),
+    f"{_DEC}/calculate_pgen/Linear/Matrix":
+        (("decoder", "pgen_linear", "kernel"), False),
+    f"{_DEC}/calculate_pgen/Linear/Bias":
+        (("decoder", "pgen_linear", "bias"), False),
+    f"{_DEC}/AttnOutputProjection/Linear/Matrix":
+        (("decoder", "output_linear", "kernel"), False),
+    f"{_DEC}/AttnOutputProjection/Linear/Bias":
+        (("decoder", "output_linear", "bias"), False),
+    "seq2seq/output_projection/w": (("output_projection", "w"), False),
+    "seq2seq/output_projection/v": (("output_projection", "v"), False),
+}
+
+# Variables we deliberately skip: optimizer slots + bookkeeping.
+_SKIP_SUFFIXES = ("/Adagrad",)
+_SKIP_NAMES = ("global_step", "train_step/last_loss")
+
+
+def import_tf1_arrays(tf1_vars: Dict[str, np.ndarray],
+                      strict: bool = True) -> PyTree:
+    """Build our params pytree from a {tf1_name: ndarray} dict.
+
+    Missing `coverage/w_c` is tolerated (non-coverage checkpoints); use
+    models.pointer_generator.add_coverage_params afterwards if needed.
+    """
+    params: Dict[str, Any] = {}
+    seen = set()
+    for name, value in tf1_vars.items():
+        if name in _SKIP_NAMES or any(name.endswith(s) for s in _SKIP_SUFFIXES):
+            continue
+        if name not in TF1_NAME_MAP:
+            if strict:
+                raise KeyError(f"unmapped TF1 variable: {name!r} "
+                               f"shape {np.shape(value)}")
+            continue
+        path, squeeze = TF1_NAME_MAP[name]
+        v = np.asarray(value, dtype=np.float32)
+        if squeeze:
+            v = np.squeeze(v)
+        node = params
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = v
+        seen.add(name)
+    required = set(TF1_NAME_MAP) - {f"{_DEC}/coverage/w_c"}
+    missing = required - seen
+    if missing:
+        raise KeyError(f"TF1 checkpoint missing variables: {sorted(missing)}")
+    return params
+
+
+def import_tf1_checkpoint(path: str, strict: bool = True) -> PyTree:
+    """Read a TF checkpoint bundle directly (requires tensorflow)."""
+    try:
+        from tensorflow.python.training import py_checkpoint_reader
+        reader = py_checkpoint_reader.NewCheckpointReader(path)
+    except ImportError as e:
+        raise ImportError(
+            "tensorflow is not available in this environment; dump the "
+            "checkpoint to {name: ndarray} with any TF installation and "
+            "call import_tf1_arrays instead") from e
+    shapes = reader.get_variable_to_shape_map()
+    return import_tf1_arrays({n: reader.get_tensor(n) for n in shapes},
+                             strict=strict)

@@ -1,0 +1,11 @@
+from textsummarization_on_flink_tpu.parallel.mesh import (  # noqa: F401
+    MeshPlan,
+    batch_pspec,
+    batch_sharding,
+    make_mesh,
+    make_sharded_eval_step,
+    make_sharded_train_step,
+    param_pspecs,
+    shard_train_state,
+    state_pspecs,
+)

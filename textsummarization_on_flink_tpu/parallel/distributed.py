@@ -1,0 +1,73 @@
+"""Multi-host initialization + cross-host utilities.
+
+Replaces the reference's cluster bring-up — ZooKeeper rendezvous
+(TFEstimator.java:50-51, MLConstants.STORAGE_ZOOKEEPER) plus
+`tf.train.Server`/`ClusterSpec` boilerplate (run_summarization.py:403-417)
+— with `jax.distributed.initialize`: the JAX coordination service is the
+rendezvous, and after initialization every host sees the global device
+list, so the same MeshPlan code works single-host and multi-host (the mesh
+just spans DCN).
+
+The parameter-server role does not exist here: where the reference's ps
+processes busy-sleep holding variables (run_summarization.py:412-415),
+SPMD keeps parameters resident on the devices that use them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up the JAX coordination service (idempotent, single-host no-op).
+
+    In a managed TPU environment all three args auto-detect; pass them
+    explicitly for manual bring-up (the equivalent of the reference's
+    zookeeper_connect_str + worker index, HasClusterConfig.java:15-29).
+    """
+    if num_processes is not None and num_processes <= 1:
+        log.info("single-process run; skipping jax.distributed.initialize")
+        return
+    # No local jax calls before initialize: anything that touches the
+    # backend (device_count, process_count, ...) would pin a single-process
+    # view and make initialization fail.  With no args this auto-detects
+    # the cluster environment (TPU metadata / cluster plugins) and is a
+    # no-op on genuinely single-process runs.
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    except (RuntimeError, ValueError) as e:
+        if coordinator_address is None and num_processes is None:
+            log.info("jax.distributed auto-detect found no cluster (%s); "
+                     "continuing single-process", e)
+            return
+        raise
+    log.info("jax.distributed up: process %d/%d, %d local / %d global devices",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+
+
+def is_chief() -> bool:
+    """The process that writes checkpoints/summaries (the reference's
+    `is_chief=True` MonitoredTrainingSession role, train.py:74-81)."""
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-host sync point (used around checkpoint save/restore)."""
+    if jax.process_count() == 1:
+        return
+    # A tiny psum over all devices acts as a barrier.
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
