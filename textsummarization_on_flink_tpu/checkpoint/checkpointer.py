@@ -54,6 +54,7 @@ BEST_INDEX_FILE = "checkpoint_best"
 def _flatten(tree: PyTree, prefix: str = "") -> Dict[str, np.ndarray]:
     """Flatten nested dicts/NamedTuples to slash-joined keys."""
     out: Dict[str, np.ndarray] = {}
+    tree = jax.device_get(tree)  # one batched D2H transfer, not per-leaf
 
     def rec(node: Any, path: str) -> None:
         if isinstance(node, dict):
@@ -63,7 +64,7 @@ def _flatten(tree: PyTree, prefix: str = "") -> Dict[str, np.ndarray]:
             for k in node._fields:
                 rec(getattr(node, k), f"{path}/{k}" if path else str(k))
         else:
-            out[path] = np.asarray(jax.device_get(node))
+            out[path] = np.asarray(node)
 
     rec(tree, prefix)
     return out
@@ -135,7 +136,8 @@ def latest_checkpoint(directory: str, index_file: str = INDEX_FILE,
                 return path
         except (json.JSONDecodeError, KeyError, OSError):
             log.warning("unreadable checkpoint index %s; rescanning", idx)
-    pattern = os.path.join(directory, f"{CKPT_PREFIX}-*.npz")
+    prefix = "bestmodel" if index_file == BEST_INDEX_FILE else CKPT_PREFIX
+    pattern = os.path.join(directory, f"{prefix}-*.npz")
     found = sorted(glob.glob(pattern), key=_ckpt_step)
     return found[-1] if found else None
 
@@ -248,15 +250,25 @@ class BestModelSaver:
 # --------------------------------------------------------------------------
 
 def convert_to_coverage_model(train_dir: str, hps: HParams,
-                              seed: int = 0) -> str:
+                              seed: int = 0, force: bool = False) -> str:
     """Add fresh coverage params to the latest non-coverage checkpoint and
     save it as `<ckpt>_cov_init` (run_summarization.py:157-178 semantics:
-    restore non-coverage vars, init the new coverage vars, save-and-exit)."""
+    restore non-coverage vars, init the new coverage vars, save-and-exit).
+
+    Refuses to re-convert a checkpoint that is itself a coverage conversion
+    (double invocation would overwrite trained coverage params with fresh
+    noise); pass force=True to override.
+    """
     from textsummarization_on_flink_tpu.models import pointer_generator as pg
 
     path = latest_checkpoint(train_dir)
     if path is None:
         raise FileNotFoundError(f"no checkpoint in {train_dir}")
+    if "_cov_init" in os.path.basename(path) and not force:
+        raise RuntimeError(
+            f"latest checkpoint {path} is already a coverage conversion; "
+            "re-converting would destroy trained coverage params "
+            "(pass force=True to override)")
     state = arrays_to_state(load_arrays(path))
     new_params = pg.add_coverage_params(state.params,
                                         jax.random.PRNGKey(seed))

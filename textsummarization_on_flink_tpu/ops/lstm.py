@@ -35,7 +35,12 @@ def lstm_cell(params: Dict[str, Array], x: Array, state: LSTMState,
               forget_bias: float = 1.0) -> Tuple[Array, LSTMState]:
     """One LSTM step. x: [B, I]; state: ([B, H], [B, H])."""
     c, h = state
-    z = jnp.concatenate([x, h], axis=-1) @ params["kernel"] + params["bias"]
+    # Compute in the activation dtype (bf16 on the MXU when the caller casts
+    # inputs); master params stay f32 and are cast per-step, so the scan
+    # carry keeps one consistent dtype.
+    kernel = params["kernel"].astype(x.dtype)
+    bias = params["bias"].astype(x.dtype)
+    z = jnp.concatenate([x, h], axis=-1) @ kernel + bias
     i, j, f, o = jnp.split(z, 4, axis=-1)
     new_c = c * jax.nn.sigmoid(f + forget_bias) + jax.nn.sigmoid(i) * jnp.tanh(j)
     new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)

@@ -48,8 +48,18 @@ def initialize(coordinator_address: Optional[str] = None,
             process_id=process_id)
     except (RuntimeError, ValueError) as e:
         if coordinator_address is None and num_processes is None:
-            log.info("jax.distributed auto-detect found no cluster (%s); "
-                     "continuing single-process", e)
+            # Only degrade to single-process when nothing in the environment
+            # suggests we are part of a cluster; a transient coordinator
+            # failure on a real multi-host job must fail fast, or every
+            # host would think it is chief and clobber shared checkpoints.
+            cluster_markers = (
+                "JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS",
+                "TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
+            )
+            if any(os.environ.get(k) for k in cluster_markers):
+                raise
+            log.warning("jax.distributed auto-detect found no cluster (%s); "
+                        "continuing single-process", e)
             return
         raise
     log.info("jax.distributed up: process %d/%d, %d local / %d global devices",

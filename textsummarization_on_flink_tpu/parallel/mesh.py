@@ -34,6 +34,7 @@ driver's `dryrun_multichip` validate multi-chip behavior without hardware.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -45,6 +46,8 @@ from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.train import trainer as trainer_lib
 
 PyTree = Any
+
+log = logging.getLogger(__name__)
 
 MESH_AXES = ("dp", "tp", "sp")
 
@@ -76,8 +79,9 @@ def make_mesh(hps: HParams, devices: Optional[Sequence[jax.Device]] = None,
               ) -> MeshPlan:
     """Build the (dp, tp, sp) mesh.
 
-    Axis sizes come from hps; the device count must equal dp*tp*sp (pass an
-    explicit device list to use a subset).  With all axes 1 this degrades
+    Axis sizes come from hps; when dp*tp*sp is smaller than the available
+    device count the mesh uses a prefix subset (and logs it — raise your
+    axis sizes to use the whole machine).  With all axes 1 this degrades
     gracefully to single-device.
     """
     devices = list(devices) if devices is not None else list(jax.devices())
@@ -85,6 +89,9 @@ def make_mesh(hps: HParams, devices: Optional[Sequence[jax.Device]] = None,
     if want > len(devices):
         raise ValueError(
             f"mesh needs dp*tp*sp={want} devices, have {len(devices)}")
+    if want < len(devices):
+        log.info("mesh uses %d of %d available devices (dp=%d tp=%d sp=%d)",
+                 want, len(devices), hps.dp, hps.tp, hps.sp)
     grid = np.asarray(devices[:want]).reshape(hps.dp, hps.tp, hps.sp)
     return MeshPlan(mesh=Mesh(grid, MESH_AXES), hps=hps)
 
