@@ -1,0 +1,1 @@
+from textsummarization_on_flink_tpu.decode import beam_search  # noqa: F401

@@ -1,0 +1,244 @@
+"""On-device batched beam search.
+
+Semantics parity with the reference's Python-side beam search
+(/root/reference/src/main/python/pointer-generator/beam_search.py), but the
+entire search runs inside one jitted `lax.while_loop` per dispatch instead
+of ~100 `sess.run` round trips per article (SURVEY.md §3.4):
+
+  * at step 0 only the first (all-identical) hypothesis is expanded
+    (beam_search.py:125 `num_orig_hyps`);
+  * each live hypothesis proposes `2*beam_size` continuations
+    (beam_search.py:127-141, model.py:280-285);
+  * candidates are processed in descending score order: a STOP candidate
+    moves to the results pool only if at least `min_dec_steps` tokens were
+    generated (earlier STOPs are *discarded*), anything else refills the
+    live beam, and processing halts once either pool holds `beam_size`
+    entries (beam_search.py:143-154);
+  * the loop ends when `beam_size` results exist or `max_dec_steps` is
+    reached; an empty results pool falls back to the live beam
+    (beam_search.py:158-162);
+  * final ranking is by length-normalized total log-prob, where the length
+    includes the START token like the reference's
+    `len(self.tokens)` (beam_search.py:71-79,164-168).
+
+Because live hypotheses all share the same length at any step, ordering by
+total log-prob during the search equals the reference's ordering by average
+log-prob; the average only matters for the final cross-length ranking.
+
+TPU-first details: all shapes are static — tokens/results live in
+`[beam, max_dec_steps+1]` buffers, the per-step candidate triage is a pure
+cumulative-sum computation over the `beam*2*beam` sorted candidates (no
+data-dependent Python), and a whole batch of B articles is searched per
+dispatch via `vmap`.  OOV ids are mapped back to UNK before the embedding
+lookup inside the loop (beam_search.py:112).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.vocab import START_ID, STOP_ID, UNK_ID
+from textsummarization_on_flink_tpu.models import pointer_generator as pg
+
+Array = jax.Array
+
+NEG = -1e30  # effectively -inf, without inf-inf NaN hazards
+
+
+class BeamSearchOutput(NamedTuple):
+    """Best hypothesis per article (batch axis leading)."""
+
+    tokens: Array  # [B, T_dec+1] extended-vocab ids, [0]=START
+    length: Array  # [B] token count including START (== reference len(tokens))
+    avg_log_prob: Array  # [B]
+    attn_dists: Array  # [B, T_dec, T_enc] attention per generated token
+    p_gens: Array  # [B, T_dec]
+
+
+class _BeamState(NamedTuple):
+    t: Array  # scalar int32: decode step (reference's `steps`)
+    tokens: Array  # [K, T+1]
+    sum_lp: Array  # [K] total log prob of live hyps
+    cell_c: Array  # [K, H]
+    cell_h: Array  # [K, H]
+    coverage: Array  # [K, T_enc]
+    attn_hist: Array  # [K, T, T_enc]
+    pgen_hist: Array  # [K, T]
+    n_res: Array  # scalar int32: filled result slots
+    res_tokens: Array  # [K+1, T+1] (row K is a scratch slot)
+    res_lp: Array  # [K+1]
+    res_len: Array  # [K+1] int32, token count incl START
+    res_attn: Array  # [K+1, T, T_enc]
+    res_pgen: Array  # [K+1, T]
+
+
+def _search_one(params, hps: HParams, enc_states, enc_feats, dec_c, dec_h,
+                enc_mask, ext_ids) -> BeamSearchOutput:
+    """Beam search for ONE article (un-batched inputs; vmapped below).
+
+    enc_states/enc_feats: [T_enc, D]; dec_c/dec_h: [H];
+    enc_mask: [T_enc]; ext_ids: [T_enc] extended-vocab encoder ids.
+    """
+    K = hps.beam_size
+    T = hps.max_dec_steps
+    T_enc = enc_states.shape[0]
+    H = dec_c.shape[0]
+    V = hps.vocab_size
+    S = K * 2 * K  # candidate count per step
+
+    enc = pg.EncoderOutput(
+        enc_states=jnp.broadcast_to(enc_states[None], (K,) + enc_states.shape),
+        enc_features=jnp.broadcast_to(enc_feats[None], (K,) + enc_feats.shape),
+        dec_in_state=(jnp.broadcast_to(dec_c[None], (K, H)),
+                      jnp.broadcast_to(dec_h[None], (K, H))))
+    mask_k = jnp.broadcast_to(enc_mask[None], (K, T_enc))
+    ext_k = jnp.broadcast_to(ext_ids[None], (K, T_enc))
+
+    init = _BeamState(
+        t=jnp.zeros((), jnp.int32),
+        tokens=jnp.full((K, T + 1), STOP_ID, jnp.int32).at[:, 0].set(START_ID),
+        sum_lp=jnp.zeros((K,), jnp.float32),
+        cell_c=enc.dec_in_state[0],
+        cell_h=enc.dec_in_state[1],
+        coverage=jnp.zeros((K, T_enc), jnp.float32),
+        attn_hist=jnp.zeros((K, T, T_enc), jnp.float32),
+        pgen_hist=jnp.zeros((K, T), jnp.float32),
+        n_res=jnp.zeros((), jnp.int32),
+        res_tokens=jnp.zeros((K + 1, T + 1), jnp.int32),
+        res_lp=jnp.full((K + 1,), NEG, jnp.float32),
+        res_len=jnp.ones((K + 1,), jnp.int32),
+        res_attn=jnp.zeros((K + 1, T, T_enc), jnp.float32),
+        res_pgen=jnp.zeros((K + 1, T), jnp.float32),
+    )
+
+    def cond(s: _BeamState):
+        return jnp.logical_and(s.t < T, s.n_res < K)
+
+    def body(s: _BeamState) -> _BeamState:
+        latest = s.tokens[:, s.t]  # [K]
+        latest = jnp.where(latest >= V, UNK_ID, latest)  # beam_search.py:112
+        step = pg.decode_onestep(params, hps, enc, mask_k, ext_k, latest,
+                                 (s.cell_c, s.cell_h), s.coverage)
+        # candidate pool: every live hyp x its 2K continuations
+        cand_lp = s.sum_lp[:, None] + step.topk_log_probs  # [K, 2K]
+        # step 0: all hyps identical -> expand only hyp 0 (beam_search.py:125)
+        first = jnp.arange(K)[:, None] == 0
+        cand_lp = jnp.where(jnp.logical_or(s.t > 0, first), cand_lp, NEG)
+        flat_lp = cand_lp.reshape(S)
+        flat_tok = step.topk_ids.reshape(S)
+        order = jnp.argsort(-flat_lp)  # stable descending
+        srt_lp = flat_lp[order]
+        srt_tok = flat_tok[order]
+        parent = order // (2 * K)  # originating live hyp
+
+        # sequential triage (beam_search.py:143-154) as cumsums: counts only
+        # advance for selected candidates, and a candidate is processed only
+        # while both pools are still short of K.
+        is_stop = srt_tok == STOP_ID
+        valid_stop = jnp.logical_and(is_stop, s.t >= hps.min_dec_steps)
+        non_stop = jnp.logical_not(is_stop)
+        live_rank = jnp.cumsum(non_stop)  # inclusive
+        res_rank = jnp.cumsum(valid_stop)
+        live_sel = non_stop & (live_rank <= K) & (s.n_res + res_rank < K)
+        res_sel = valid_stop & (s.n_res + res_rank <= K) & (live_rank < K)
+
+        # --- rebuild the live beam ---
+        sel = jnp.argsort(jnp.logical_not(live_sel))[:K]  # first K selected
+        ok = live_sel[sel]  # all True unless results filled first
+        par = parent[sel]
+        new_tokens = s.tokens[par].at[:, s.t + 1].set(srt_tok[sel])
+        new_sum_lp = jnp.where(ok, srt_lp[sel], NEG)
+        new_attn = s.attn_hist[par].at[:, s.t].set(step.attn_dist[par])
+        new_pgen = s.pgen_hist[par].at[:, s.t].set(step.p_gen[par])
+
+        # --- scatter finished hypotheses into result slots ---
+        slot = jnp.where(res_sel, s.n_res + res_rank - 1, K)  # K = scratch
+        cand_tokens = s.tokens[parent].at[:, s.t + 1].set(srt_tok)  # [S, T+1]
+        cand_attn = s.attn_hist[parent].at[:, s.t].set(step.attn_dist[parent])
+        cand_pgen = s.pgen_hist[parent].at[:, s.t].set(step.p_gen[parent])
+        res_tokens = s.res_tokens.at[slot].set(cand_tokens)
+        res_lp = s.res_lp.at[slot].set(jnp.where(res_sel, srt_lp, NEG))
+        res_len = s.res_len.at[slot].set(s.t + 2)  # START + t+1 generated
+        res_attn = s.res_attn.at[slot].set(cand_attn)
+        res_pgen = s.res_pgen.at[slot].set(cand_pgen)
+        # scratch row K may hold garbage; restore invariants there
+        res_lp = res_lp.at[K].set(NEG)
+
+        return _BeamState(
+            t=s.t + 1,
+            tokens=new_tokens,
+            sum_lp=new_sum_lp,
+            cell_c=step.state[0][par],
+            cell_h=step.state[1][par],
+            coverage=step.coverage[par],
+            attn_hist=new_attn,
+            pgen_hist=new_pgen,
+            n_res=s.n_res + jnp.sum(res_sel).astype(jnp.int32),
+            res_tokens=res_tokens,
+            res_lp=res_lp,
+            res_len=res_len,
+            res_attn=res_attn,
+            res_pgen=res_pgen,
+        )
+
+    s = jax.lax.while_loop(cond, body, init)
+
+    # results empty -> fall back to the live beam (beam_search.py:158-160)
+    use_live = s.n_res == 0
+    live_len = s.t + 1  # START + t generated tokens
+    pool_lp = jnp.where(use_live, jnp.concatenate([s.sum_lp, jnp.array([NEG])]),
+                        s.res_lp)
+    pool_len = jnp.where(use_live, jnp.full((K + 1,), live_len),
+                         s.res_len)
+    pool_tokens = jnp.where(use_live,
+                            jnp.concatenate([s.tokens,
+                                             jnp.zeros((1, T + 1), jnp.int32)]),
+                            s.res_tokens)
+    pool_attn = jnp.where(
+        use_live,
+        jnp.concatenate([s.attn_hist, jnp.zeros((1, T, T_enc))]), s.res_attn)
+    pool_pgen = jnp.where(
+        use_live, jnp.concatenate([s.pgen_hist, jnp.zeros((1, T))]), s.res_pgen)
+
+    avg = pool_lp / pool_len.astype(jnp.float32)  # beam_search.py:77-79
+    avg = jnp.where(pool_lp <= NEG / 2, NEG, avg)  # keep empty slots last
+    best = jnp.argmax(avg)
+    return BeamSearchOutput(tokens=pool_tokens[best],
+                            length=pool_len[best],
+                            avg_log_prob=avg[best],
+                            attn_dists=pool_attn[best],
+                            p_gens=pool_pgen[best])
+
+
+def _search_batch(params, hps: HParams, arrays: Dict[str, Array],
+                  ) -> BeamSearchOutput:
+    """Encode a batch of B articles once, then vmap the per-article search."""
+    enc = pg.run_encoder(params, hps, arrays)
+    fn = functools.partial(_search_one, params, hps)
+    return jax.vmap(fn)(enc.enc_states, enc.enc_features,
+                        enc.dec_in_state[0], enc.dec_in_state[1],
+                        arrays["enc_padding_mask"],
+                        arrays["enc_batch_extend_vocab"])
+
+
+@functools.partial(jax.jit, static_argnames=("hps",))
+def run_beam_search_jit(params, hps: HParams, arrays: Dict[str, Array],
+                        ) -> BeamSearchOutput:
+    return _search_batch(params, hps, arrays)
+
+
+def run_beam_search(params, hps: HParams, arrays: Dict[str, np.ndarray],
+                    ) -> BeamSearchOutput:
+    """Host entry: one compiled dispatch decodes the whole batch.
+
+    Returns host numpy BeamSearchOutput; callers strip START/[STOP] and map
+    ids back to words (decode/decoder.py, mirroring decode.py:109-119).
+    """
+    out = run_beam_search_jit(params, hps, arrays)
+    return BeamSearchOutput(*[np.asarray(x) for x in out])
