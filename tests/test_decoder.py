@@ -1,0 +1,142 @@
+"""Decoder driver: end-to-end decode over the checkpoint layer + writers."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.batcher import Batcher
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.decode import decoder as dec_lib
+from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+
+WORDS = ("the a cat dog sat ran mat home big small quick brown fox jumped "
+         "over lazy it was day night").split()
+
+HPS = HParams(batch_size=2, hidden_dim=8, emb_dim=6, vocab_size=24,
+              max_enc_steps=16, max_dec_steps=8, beam_size=2,
+              min_dec_steps=1, max_oov_buckets=4, mode="decode",
+              single_pass=True)
+
+
+@pytest.fixture(scope="module")
+def vocab():
+    return Vocab(words=WORDS)
+
+
+def article(i):
+    return f"the quick brown fox {WORDS[i % len(WORDS)]} over the lazy dog ."
+
+
+def abstract(i):
+    return f"<s> the fox {WORDS[i % len(WORDS)]} . </s>"
+
+
+def make_source(n):
+    def src():
+        return iter([(article(i), abstract(i)) for i in range(n)])
+    return src
+
+
+@pytest.fixture(scope="module")
+def train_dir(tmp_path_factory, vocab):
+    d = str(tmp_path_factory.mktemp("train"))
+    state = trainer_lib.init_train_state(HPS, vocab.size(), seed=0)
+    ckpt_lib.Checkpointer(d, hps=HPS).save(state)
+    return d
+
+
+def test_words_to_sentences():
+    ws = "the cat sat . a dog ran . tail".split()
+    assert dec_lib.words_to_sentences(ws) == \
+        ["the cat sat .", "a dog ran .", "tail"]
+    assert dec_lib.words_to_sentences([]) == []
+
+
+def test_make_html_safe():
+    assert dec_lib.make_html_safe("<s> a </s>") == "&lt;s&gt; a &lt;/s&gt;"
+
+
+def test_decode_dir_name():
+    name = dec_lib.get_decode_dir_name(HPS, "/x/model.ckpt-42.npz")
+    assert name == "decode_ckpt-42_16maxenc_2beam_1mindec_8maxdec"
+
+
+def test_single_pass_decode_with_rouge(tmp_path, vocab, train_dir):
+    hps = HPS
+    batcher = Batcher("", vocab, hps, single_pass=True,
+                      decode_batch_mode="distinct",
+                      example_source=make_source(3))
+    d = dec_lib.BeamSearchDecoder(hps, vocab, batcher, train_dir=train_dir,
+                                  decode_root=str(tmp_path),
+                                  max_ckpt_retries=0)
+    results = d.decode(with_rouge=True)
+    assert results is not None and "rouge_1" in results
+    dec_dir = os.path.join(str(tmp_path),
+                           dec_lib.get_decode_dir_name(hps, d._ckpt_path))
+    ref_files = sorted(os.listdir(os.path.join(dec_dir, "reference")))
+    dec_files = sorted(os.listdir(os.path.join(dec_dir, "decoded")))
+    assert len(ref_files) == 3 and len(dec_files) == 3
+    assert os.path.exists(os.path.join(dec_dir, "ROUGE_results.txt"))
+    # reference files hold the abstract sentences
+    with open(os.path.join(dec_dir, "reference", ref_files[0])) as f:
+        assert "fox" in f.read()
+
+
+def test_single_pass_refuses_existing_dir(tmp_path, vocab, train_dir):
+    batcher = Batcher("", vocab, HPS, single_pass=True,
+                      example_source=make_source(1))
+    d = dec_lib.BeamSearchDecoder(HPS, vocab, batcher, train_dir=train_dir,
+                                  decode_root=str(tmp_path),
+                                  max_ckpt_retries=0)
+    with pytest.raises(FileExistsError):
+        dec_lib.BeamSearchDecoder(HPS, vocab, batcher, train_dir=train_dir,
+                                  decode_root=str(tmp_path),
+                                  max_ckpt_retries=0)
+    del d
+
+
+def test_continuous_decode_sink_and_attnvis(tmp_path, vocab, train_dir):
+    hps = HPS.replace(single_pass=False)
+    batcher = Batcher("", vocab, hps, single_pass=True,  # finite source
+                      decode_batch_mode="repeat",
+                      example_source=make_source(2))
+    d = dec_lib.BeamSearchDecoder(hps, vocab, batcher, train_dir=train_dir,
+                                  decode_root=str(tmp_path),
+                                  max_ckpt_retries=0)
+    rows = []
+    d.decode(result_sink=lambda r: rows.append(r.as_row()))
+    # repeat-mode batches collapse to one distinct article each
+    assert len(rows) == 2
+    uuid, art, summary, ref = rows[0]
+    assert "fox" in art
+    assert isinstance(summary, str)
+    vis = os.path.join(str(tmp_path), "decode", "attn_vis_data.json")
+    with open(vis) as f:
+        data = json.load(f)
+    assert set(data) >= {"article_lst", "decoded_lst", "abstract_str",
+                         "attn_dists"}
+    assert "p_gens" in data  # pointer_gen on
+    # attention rows align with the article token count
+    assert all(len(row) <= len(data["article_lst"])
+               for row in data["attn_dists"])
+
+
+def test_decode_batch_emits_valid_words(tmp_path, vocab, train_dir):
+    hps = HPS.replace(single_pass=False)
+    batcher = Batcher("", vocab, hps, single_pass=True,
+                      decode_batch_mode="distinct",
+                      example_source=make_source(2))
+    d = dec_lib.BeamSearchDecoder(hps, vocab, batcher, train_dir=train_dir,
+                                  decode_root=str(tmp_path),
+                                  max_ckpt_retries=0)
+    batch = batcher.next_batch()
+    results = d.decode_batch(batch)
+    assert 1 <= len(results) <= hps.batch_size
+    for r in results:
+        for w in r.decoded_words:
+            assert isinstance(w, str) and w  # real words, never raw ids
+            assert w != "[STOP]"

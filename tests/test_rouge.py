@@ -1,0 +1,105 @@
+"""ROUGE scorer: hand-computed values, properties, and file-layout eval."""
+
+import os
+
+import numpy as np
+import pytest
+
+from textsummarization_on_flink_tpu.evaluate import rouge
+
+
+def test_rouge1_exact():
+    # peer: "the cat sat" vs model: "the cat ran" -> 2/3 overlap both ways
+    s = rouge.rouge_n(["the cat sat"], ["the cat ran"], 1)
+    assert s.precision == pytest.approx(2 / 3)
+    assert s.recall == pytest.approx(2 / 3)
+    assert s.f == pytest.approx(2 / 3)
+
+
+def test_rouge2_exact():
+    # bigrams peer: {the cat, cat sat}; model: {the cat, cat ran} -> 1 hit
+    s = rouge.rouge_n(["the cat sat"], ["the cat ran"], 2)
+    assert s.precision == pytest.approx(1 / 2)
+    assert s.recall == pytest.approx(1 / 2)
+
+
+def test_rouge1_clipping():
+    # repeated peer tokens are clipped by model counts
+    s = rouge.rouge_n(["the the the the"], ["the cat"], 1)
+    assert s.recall == pytest.approx(1 / 2)  # 1 hit / 2 model tokens
+    assert s.precision == pytest.approx(1 / 4)
+
+
+def test_rouge_l_exact():
+    # LCS("the cat sat on the mat", "the cat ate the mat") per Lin 2004
+    s = rouge.rouge_l(["the cat sat on the mat"], ["the cat ate the mat"])
+    # LCS = the cat the mat (4); model 5 words, peer 6
+    assert s.recall == pytest.approx(4 / 5)
+    assert s.precision == pytest.approx(4 / 6)
+
+
+def test_rouge_l_union():
+    # union LCS across peer sentences (Lin 2004 §3.2 example):
+    # model "w1 w2 w3 w4 w5", peers "w1 w2 6 7 8" and "w1 3 8 9 w5"
+    s = rouge.rouge_l(["w1 w2 6 7 8", "w1 3 8 9 w5"], ["w1 w2 w3 w4 w5"])
+    assert s.recall == pytest.approx(3 / 5)  # union hits {w1, w2, w5}
+    assert s.precision == pytest.approx(3 / 10)
+
+
+def test_identical_summaries_score_one():
+    doc = ["some sentence here", "another one follows"]
+    for m, s in rouge.score_document(doc, doc).items():
+        assert s.f == pytest.approx(1.0), m
+
+
+def test_disjoint_summaries_score_zero():
+    out = rouge.score_document(["aaa bbb"], ["ccc ddd"])
+    for m, s in out.items():
+        assert s.f == 0.0, m
+
+
+def test_tokenize_case_and_punct():
+    assert rouge.tokenize("The Cat, sat!") == ["the", "cat", "sat"]
+
+
+def test_corpus_and_ci_shapes():
+    peers = [["the cat sat"], ["a dog ran away"], ["hello world"]]
+    models = [["the cat ran"], ["a dog ran home"], ["hello there world"]]
+    res = rouge.score_corpus(peers, models, n_bootstrap=200)
+    for m in ("rouge_1", "rouge_2", "rouge_l"):
+        for stat in ("f_score", "recall", "precision"):
+            v = res[m][stat]
+            lo, hi = res[m][f"{stat}_cb"], res[m][f"{stat}_ce"]
+            assert 0.0 <= lo <= hi <= 1.0
+            assert 0.0 <= v <= 1.0
+    # mean within its own CI
+    assert res["rouge_1"]["f_score_cb"] <= res["rouge_1"]["f_score"] \
+        <= res["rouge_1"]["f_score_ce"]
+
+
+def test_rouge_eval_file_layout(tmp_path):
+    ref_dir = tmp_path / "reference"
+    dec_dir = tmp_path / "decoded"
+    ref_dir.mkdir()
+    dec_dir.mkdir()
+    docs = [("the cat sat on the mat", "the cat sat on the mat"),
+            ("a dog barked loudly", "a dog howled loudly")]
+    for i, (ref, dec) in enumerate(docs):
+        (ref_dir / f"{i:06d}_reference.txt").write_text(ref + "\n")
+        (dec_dir / f"{i:06d}_decoded.txt").write_text(dec + "\n")
+    res = rouge.rouge_eval(str(ref_dir), str(dec_dir), n_bootstrap=100)
+    assert res["rouge_1"]["f_score"] > 0.8
+    text = rouge.rouge_log(res, str(tmp_path / "out"))
+    assert "ROUGE-1:" in text and "ROUGE-2:" in text and "ROUGE-l:" in text
+    assert "confidence interval" in text
+    assert os.path.exists(tmp_path / "out" / "ROUGE_results.txt")
+
+
+def test_rouge_eval_missing_decoded(tmp_path):
+    ref_dir = tmp_path / "reference"
+    dec_dir = tmp_path / "decoded"
+    ref_dir.mkdir()
+    dec_dir.mkdir()
+    (ref_dir / "000000_reference.txt").write_text("x\n")
+    with pytest.raises(FileNotFoundError):
+        rouge.rouge_eval(str(ref_dir), str(dec_dir))

@@ -1,1 +1,2 @@
 from textsummarization_on_flink_tpu.decode import beam_search  # noqa: F401
+from textsummarization_on_flink_tpu.decode import decoder  # noqa: F401

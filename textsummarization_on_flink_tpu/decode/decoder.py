@@ -1,0 +1,290 @@
+"""Beam-search decode driver: checkpoint loading, decode loop, writers.
+
+Rebuilds the reference BeamSearchDecoder
+(/root/reference/src/main/python/pointer-generator/decode.py) TPU-first:
+instead of one encoder `sess.run` plus ~100 single-step `sess.run`s per
+article (decode.py:95-106 -> beam_search.py:118), each batch of articles is
+decoded in ONE device dispatch (decode/beam_search.py), and the TF
+Saver/session machinery is replaced by the npz checkpoint layer.
+
+Preserved behavior:
+  * decode-dir naming from the checkpoint name + key hps
+    (`get_decode_dir_name`, decode.py:303-313);
+  * single-pass mode writes pyrouge-layout reference/decoded files and runs
+    ROUGE at the end (decode.py:133-147, 187-222, 268-301);
+  * continuous mode periodically reloads the newest checkpoint
+    (SECS_UNTIL_NEW_CKPT=60, decode.py:36,149-157) and writes the
+    attention-visualizer JSON (decode.py:225-249);
+  * `[STOP]`-truncation of the emitted token stream (decode.py:112-118);
+  * html-escaping of <, > in outputs (`make_html_safe`, decode.py:252-255);
+  * streaming results carry (uuid, article, summary, reference) rows with
+    the summary sentence-split on '.' (`write_for_flink`, decode.py:159-185).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data import oov as oov_lib
+from textsummarization_on_flink_tpu.data.batching import Batch
+from textsummarization_on_flink_tpu.data.vocab import STOP_DECODING, Vocab
+from textsummarization_on_flink_tpu.decode import beam_search
+from textsummarization_on_flink_tpu.evaluate import rouge
+
+log = logging.getLogger(__name__)
+
+SECS_UNTIL_NEW_CKPT = 60  # decode.py:36
+
+
+def make_html_safe(s: str) -> str:
+    """decode.py:252-255."""
+    return s.replace("<", "&lt;").replace(">", "&gt;")
+
+
+def words_to_sentences(decoded_words: List[str]) -> List[str]:
+    """Split a decoded word stream into '.'-terminated sentences
+    (decode.py:193-201 / write_for_flink :166-175)."""
+    words = list(decoded_words)
+    sents: List[str] = []
+    while words:
+        try:
+            fst_period_idx = words.index(".")
+        except ValueError:
+            fst_period_idx = len(words) - 1
+        sent = words[: fst_period_idx + 1]
+        words = words[fst_period_idx + 1:]
+        sents.append(" ".join(sent))
+    return sents
+
+
+def get_decode_dir_name(hps: HParams, ckpt_path: Optional[str]) -> str:
+    """decode.py:303-313 naming (ckpt basename + key decode hps)."""
+    if ckpt_path is not None:
+        ckpt_name = "ckpt-" + os.path.basename(ckpt_path).split("-")[-1].split(".")[0]
+    else:
+        ckpt_name = "ckpt-none"
+    return (f"decode_{ckpt_name}_{hps.max_enc_steps}maxenc_"
+            f"{hps.beam_size}beam_{hps.min_dec_steps}mindec_"
+            f"{hps.max_dec_steps}maxdec")
+
+
+class DecodedResult:
+    """One article's decode output (the streaming-row payload)."""
+
+    def __init__(self, uuid: str, article: str, decoded_words: List[str],
+                 reference: str, abstract_sents: List[str],
+                 attn_dists: Optional[np.ndarray] = None,
+                 p_gens: Optional[np.ndarray] = None):
+        self.uuid = uuid
+        self.article = article
+        self.decoded_words = decoded_words
+        self.reference = reference
+        self.abstract_sents = abstract_sents
+        self.attn_dists = attn_dists
+        self.p_gens = p_gens
+
+    @property
+    def decoded_sents(self) -> List[str]:
+        return [make_html_safe(s) for s in words_to_sentences(self.decoded_words)]
+
+    @property
+    def summary(self) -> str:
+        return " ".join(self.decoded_sents)
+
+    def as_row(self) -> Tuple[str, str, str, str]:
+        """(uuid, article, summary, reference) — the write_for_flink row
+        (flink_writer.py:22-34 field set)."""
+        return (self.uuid, self.article, self.summary, self.reference)
+
+
+class BeamSearchDecoder:
+    """Decode loop driver (decode.py:42-157).
+
+    params_source: either a static params pytree (`params=`) or a train
+    dir to load checkpoints from (`train_dir=`, with load_ckpt retry —
+    util.py:29-41 — and 60s reloads in continuous mode).
+    """
+
+    def __init__(self, hps: HParams, vocab: Vocab, batcher: Any,
+                 params: Optional[Any] = None,
+                 train_dir: Optional[str] = None,
+                 decode_root: Optional[str] = None,
+                 max_ckpt_retries: Optional[int] = None):
+        if params is None and train_dir is None:
+            raise ValueError("need params or train_dir")
+        self._hps = hps
+        self._vocab = vocab
+        self._batcher = batcher
+        self._train_dir = train_dir
+        self._max_ckpt_retries = max_ckpt_retries
+        self._ckpt_path: Optional[str] = None
+        self._params = params
+        if params is None:
+            self._load_params()
+
+        root = decode_root or os.path.join(hps.log_root or ".",
+                                           hps.exp_name or "exp")
+        if hps.single_pass:
+            self._decode_dir = os.path.join(
+                root, get_decode_dir_name(hps, self._ckpt_path))
+            if os.path.exists(self._decode_dir):
+                raise FileExistsError(
+                    f"single_pass decode directory {self._decode_dir} should "
+                    "not already exist")  # decode.py:70-71
+        else:
+            self._decode_dir = os.path.join(root, "decode")
+        os.makedirs(self._decode_dir, exist_ok=True)
+        self._rouge_ref_dir = os.path.join(self._decode_dir, "reference")
+        self._rouge_dec_dir = os.path.join(self._decode_dir, "decoded")
+        if hps.single_pass:
+            os.makedirs(self._rouge_ref_dir, exist_ok=True)
+            os.makedirs(self._rouge_dec_dir, exist_ok=True)
+
+    # -- checkpoint handling --
+    def _load_params(self) -> None:
+        path, flat = ckpt_lib.load_ckpt(self._train_dir,
+                                        max_retries=self._max_ckpt_retries)
+        state = ckpt_lib.arrays_to_state(flat)
+        self._params = state.params
+        self._ckpt_path = path
+        log.info("decoder loaded checkpoint %s", path)
+
+    def maybe_reload_checkpoint(self, last_load: float) -> float:
+        """Continuous-serving checkpoint refresh (decode.py:149-157)."""
+        if self._train_dir is None:
+            return last_load
+        if time.time() - last_load < SECS_UNTIL_NEW_CKPT:
+            return last_load
+        latest = ckpt_lib.latest_checkpoint(self._train_dir)
+        if latest is not None and latest != self._ckpt_path:
+            log.info("Decoder has been decoding for %.0f seconds; loading "
+                     "new checkpoint", time.time() - last_load)
+            self._load_params()
+        return time.time()
+
+    # -- decoding --
+    def decode_batch(self, batch: Batch) -> List[DecodedResult]:
+        """One device dispatch for the whole batch; returns one result per
+        DISTINCT article (decode-mode batches may repeat one article
+        beam_size times, batcher.py:344-347 — repeats are collapsed)."""
+        out = beam_search.run_beam_search(self._params, self._hps,
+                                          batch.as_arrays())
+        results: List[DecodedResult] = []
+        seen: set = set()
+        for b in range(len(batch.original_articles)):
+            key = (batch.uuids[b], batch.original_articles[b])
+            if key in seen:
+                continue
+            seen.add(key)
+            n = int(out.length[b])
+            output_ids = [int(t) for t in out.tokens[b][1:n]]  # strip START
+            decoded_words = oov_lib.outputids2words(
+                output_ids, self._vocab, batch.art_oovs[b])
+            # strip [STOP] if present (decode.py:112-118)
+            try:
+                fst_stop_idx = decoded_words.index(STOP_DECODING)
+                decoded_words = decoded_words[:fst_stop_idx]
+            except ValueError:
+                pass
+            results.append(DecodedResult(
+                uuid=batch.uuids[b],
+                article=batch.original_articles[b],
+                decoded_words=decoded_words,
+                reference=batch.references[b],
+                abstract_sents=batch.original_abstracts_sents[b],
+                attn_dists=out.attn_dists[b, : max(len(decoded_words), 1)],
+                p_gens=out.p_gens[b, : max(len(decoded_words), 1)]))
+        return results
+
+    def decode(self, with_rouge: bool = True,
+               result_sink: Optional[Callable[[DecodedResult], None]] = None,
+               max_batches: int = 0) -> Optional[Dict[str, Dict[str, float]]]:
+        """The main loop (decode.py:131-157).
+
+        single_pass: decode everything once, write rouge files, then
+        evaluate (when with_rouge).  Otherwise: decode forever (or until the
+        batcher ends / max_batches), pushing results to `result_sink`
+        immediately — no buffering, the Issue-6 fix — reloading fresh
+        checkpoints every 60s.
+        """
+        t_last = time.time()
+        counter = 0
+        n_batches = 0
+        while True:
+            batch = self._batcher.next_batch()
+            if batch is None:
+                if self._hps.single_pass:
+                    log.info("Decoder has finished reading dataset for "
+                             "single_pass.")
+                    break
+                log.info("batcher exhausted; stopping decode loop")
+                break
+            t0 = time.time()
+            results = self.decode_batch(batch)
+            log.info("decoded batch of %d article(s) in %.3f s",
+                     len(results), time.time() - t0)
+            for res in results:
+                if self._hps.single_pass:
+                    self.write_for_rouge(res, counter)
+                    counter += 1
+                else:
+                    log.info("ARTICLE: %s", res.article)
+                    log.info("GENERATED SUMMARY: %s", res.summary)
+                    self.write_for_attnvis(res)
+                if result_sink is not None:
+                    result_sink(res)  # immediate flush
+            n_batches += 1
+            if max_batches and n_batches >= max_batches:
+                break
+            if not self._hps.single_pass:
+                t_last = self.maybe_reload_checkpoint(t_last)
+        if self._hps.single_pass and with_rouge and counter > 0:
+            log.info("Output has been saved in %s and %s. Now starting "
+                     "ROUGE eval...", self._rouge_ref_dir, self._rouge_dec_dir)
+            results_dict = rouge.rouge_eval(self._rouge_ref_dir,
+                                            self._rouge_dec_dir)
+            rouge.rouge_log(results_dict, self._decode_dir)
+            return results_dict
+        return None
+
+    # -- writers --
+    def write_for_rouge(self, res: DecodedResult, ex_index: int) -> None:
+        """pyrouge file layout (decode.py:187-222)."""
+        decoded_sents = res.decoded_sents
+        reference_sents = [make_html_safe(s) for s in res.abstract_sents]
+        ref_file = os.path.join(self._rouge_ref_dir,
+                                f"{ex_index:06d}_reference.txt")
+        decoded_file = os.path.join(self._rouge_dec_dir,
+                                    f"{ex_index:06d}_decoded.txt")
+        with open(ref_file, "w", encoding="utf-8") as f:
+            for idx, sent in enumerate(reference_sents):
+                f.write(sent + ("\n" if idx < len(reference_sents) - 1 else ""))
+        with open(decoded_file, "w", encoding="utf-8") as f:
+            for idx, sent in enumerate(decoded_sents):
+                f.write(sent + ("\n" if idx < len(decoded_sents) - 1 else ""))
+        log.info("Wrote example %i to file", ex_index)
+
+    def write_for_attnvis(self, res: DecodedResult) -> None:
+        """attn_vis JSON (decode.py:225-249 field layout)."""
+        article_lst = res.article.split()
+        to_write = {
+            "article_lst": [make_html_safe(t) for t in article_lst],
+            "decoded_lst": [make_html_safe(t) for t in res.decoded_words],
+            "abstract_str": make_html_safe(" ".join(res.abstract_sents)),
+            "attn_dists": (res.attn_dists[:, : len(article_lst)].tolist()
+                           if res.attn_dists is not None else []),
+        }
+        if self._hps.pointer_gen and res.p_gens is not None:
+            to_write["p_gens"] = res.p_gens.tolist()
+        output_fname = os.path.join(self._decode_dir, "attn_vis_data.json")
+        with open(output_fname, "w", encoding="utf-8") as f:
+            json.dump(to_write, f)
+        log.info("Wrote visualization data to %s", output_fname)

@@ -1,0 +1,1 @@
+from textsummarization_on_flink_tpu.evaluate import rouge  # noqa: F401
