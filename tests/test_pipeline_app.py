@@ -1,0 +1,69 @@
+"""App driver: train-then-serve end-to-end with pluggable sources/sinks."""
+
+import pytest
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.pipeline import app as app_lib
+from textsummarization_on_flink_tpu.pipeline.io import (
+    CollectionSink,
+    CollectionSource,
+)
+
+WORDS = ("article reference the a quick brown fox jumped over lazy dog "
+         "0 1 2 3 4 5 6 7").split()
+
+
+def rows(n=8):
+    return [(f"uuid-{i}", f"article {i} .", "", f"reference {i} .")
+            for i in range(n)]
+
+
+def tiny_hps(tmp_path, mode, **kw):
+    base = dict(mode=mode, batch_size=4, hidden_dim=8, emb_dim=6,
+                vocab_size=24, max_enc_steps=12, max_dec_steps=6,
+                beam_size=2, min_dec_steps=1, max_oov_buckets=4,
+                log_root=str(tmp_path), exp_name="exp")
+    base.update(kw)
+    return HParams(**base)
+
+
+def test_app_main_train_then_serve(tmp_path):
+    vocab = Vocab(words=WORDS)
+    app = app_lib.App(train_hps=tiny_hps(tmp_path, "train", num_steps=2),
+                      inference_hps=tiny_hps(tmp_path, "decode"),
+                      vocab=vocab)
+    sink = CollectionSink()
+    out = app.main(train_source=CollectionSource(rows()),
+                   infer_source=CollectionSource(rows(4)),
+                   sink=sink)
+    assert out is sink
+    assert len(sink.rows) == 4
+    for uuid, article, summary, reference in sink.rows:
+        assert uuid.startswith("uuid-")
+        assert isinstance(summary, str)
+
+
+def test_app_inference_from_model_json(tmp_path):
+    vocab = Vocab(words=WORDS)
+    app = app_lib.App(train_hps=tiny_hps(tmp_path, "train", num_steps=1),
+                      inference_hps=tiny_hps(tmp_path, "decode"),
+                      vocab=vocab)
+    model_json = app.start_training(CollectionSource(rows()))
+    assert "inference_selected_cols" in model_json
+    sink = app.start_inference(model_json,
+                               source=CollectionSource(rows(2)),
+                               sink=CollectionSink())
+    assert len(sink.rows) == 2
+
+
+def test_default_hps_match_reference_app():
+    t = app_lib.default_train_hps("/tmp/x")
+    assert (t.batch_size, t.max_enc_steps, t.max_dec_steps) == (2, 50, 10)
+    assert t.coverage
+    i = app_lib.default_inference_hps("/tmp/x")
+    assert (i.batch_size, i.max_enc_steps, i.max_dec_steps,
+            i.beam_size, i.min_dec_steps) == (4, 400, 100, 4, 35)
+    assert app_lib.TRAIN_TOPIC == "flink_train"
+    assert app_lib.INPUT_TOPIC == "flink_input"
+    assert app_lib.OUTPUT_TOPIC == "flink_output"

@@ -1,0 +1,99 @@
+"""Param system: typing, defaults, required, JSON round-trip, group surface."""
+
+import pytest
+
+from textsummarization_on_flink_tpu.pipeline import params as P
+
+
+def test_defaults_match_reference():
+    # HasClusterConfig.java:15-29 defaults
+    c = P.HasClusterConfig()
+    assert c.get_coordinator_address() == "127.0.0.1:2181"
+    assert c.get_worker_num() == 1
+    assert c.get_ps_num() == 0
+    # reference-name alias
+    assert c.get_zookeeper_connect_str() == "127.0.0.1:2181"
+
+
+def test_typed_set_rejects_wrong_type():
+    c = P.HasClusterConfig()
+    with pytest.raises(TypeError):
+        c.set_worker_num("two")
+
+
+def test_validator_rejects_bad_value():
+    c = P.HasClusterConfig()
+    with pytest.raises(ValueError):
+        c.set_worker_num(0)
+
+
+def test_required_param_raises_when_missing():
+    s = P.HasTrainSelectedCols()
+    with pytest.raises(KeyError):
+        s.get_train_selected_cols()
+    s.set_train_selected_cols(["uuid", "article", "reference"])
+    assert s.get_train_selected_cols() == ["uuid", "article", "reference"]
+
+
+def test_non_empty_validator():
+    s = P.HasTrainSelectedCols()
+    with pytest.raises(ValueError):
+        s.set_train_selected_cols([])
+
+
+def test_params_json_round_trip():
+    c = P.HasClusterConfig()
+    c.set_worker_num(4).set_coordinator_address("10.0.0.1:1234")
+    j = c.params.to_json()
+    c2 = P.HasClusterConfig()
+    c2.params.load_json(j)
+    assert c2.get_worker_num() == 4
+    assert c2.get_coordinator_address() == "10.0.0.1:1234"
+
+
+def test_hyper_params_key_default():
+    t = P.HasTrainPythonConfig()
+    assert t.get_train_hyper_params_key() == "TF_Hyperparameter"
+    i = P.HasInferencePythonConfig()
+    assert i.get_inference_hyper_params_key() == "TF_Hyperparameter"
+
+
+def test_train_inference_groups_are_independent():
+    """Train/inference params deliberately duplicated (Integration
+    Report:30) so estimator and model can diverge."""
+
+    class Both(P.HasTrainPythonConfig, P.HasInferencePythonConfig):
+        pass
+
+    b = Both()
+    b.set_train_hyper_params(["--mode=train"])
+    b.set_inference_hyper_params(["--mode=decode"])
+    assert b.get_train_hyper_params() == ["--mode=train"]
+    assert b.get_inference_hyper_params() == ["--mode=decode"]
+
+
+def test_all_eight_groups_exist():
+    for g in (P.HasClusterConfig, P.HasTrainPythonConfig,
+              P.HasInferencePythonConfig, P.HasTrainSelectedCols,
+              P.HasTrainOutputCols, P.HasTrainOutputTypes,
+              P.HasInferenceSelectedCols, P.HasInferenceOutputCols,
+              P.HasInferenceOutputTypes):
+        assert issubclass(g, P.WithParams)
+
+
+def test_load_params_json_revalidates_types():
+    c = P.HasClusterConfig()
+    with pytest.raises(TypeError):
+        c.load_params_json('{"worker_num": "three"}')
+    with pytest.raises(ValueError):
+        c.load_params_json('{"worker_num": 0}')
+    c.load_params_json('{"worker_num": 5, "unknown_extra": "kept"}')
+    assert c.get_worker_num() == 5
+
+
+def test_param_infos_collects_over_mro():
+    class Both(P.HasClusterConfig, P.HasTrainSelectedCols):
+        pass
+
+    infos = Both.param_infos()
+    assert "worker_num" in infos and "train_selected_cols" in infos

@@ -39,14 +39,15 @@ class Batcher:
     def __init__(self, data_path: str, vocab: Vocab, hps: HParams,
                  single_pass: bool, decode_batch_mode: str = "repeat",
                  watch_interval: float = 60.0,
-                 example_source: Optional[Callable[[], Iterator[Tuple[str, str]]]] = None):
+                 example_source: Optional[Callable[[], Iterator[Tuple[str, ...]]]] = None):
         """
         Args:
           data_path: chunk-file glob (ignored when example_source given).
           decode_batch_mode: 'repeat' mirrors the reference (one example
             repeated across the batch); 'distinct' packs distinct articles.
-          example_source: optional zero-arg callable returning an iterator of
-            (article, abstract) string pairs — the streaming-bridge hook.
+          example_source: optional zero-arg callable returning an iterator
+            of (article, abstract) pairs or (uuid, article, abstract,
+            reference) passthrough 4-tuples — the streaming-bridge hook.
         """
         self._data_path = data_path
         self._vocab = vocab
@@ -88,26 +89,34 @@ class Batcher:
 
     # -- consumer API --
     def next_batch(self) -> Optional[Batch]:
-        """Next Batch, or None when a single_pass dataset is exhausted."""
-        if self._batch_queue.qsize() == 0:
-            log.warning(
-                "Bucket input queue is empty when calling next_batch. "
-                "Bucket queue size: %i, Input queue size: %i",
-                self._batch_queue.qsize(), self._example_queue.qsize())
-            if self._single_pass and self._finished_reading:
-                # drain stragglers the batch thread may still be packing
-                for _ in range(100):
-                    if self._batch_queue.qsize() or not any(
-                            t.is_alive() for t in self._batch_q_threads):
-                        break
-                    time.sleep(0.05)
-                if self._batch_queue.qsize() == 0:
-                    log.info("Finished reading dataset in single_pass mode.")
-                    return None
-        return self._batch_queue.get()
+        """Next Batch, or None when a single_pass dataset is exhausted.
+
+        Polls rather than blocking indefinitely: end-of-stream can arrive
+        AFTER a consumer is already parked in get() (the source closes with
+        no further batches), so the wait must re-check _finished_reading.
+        """
+        warned = False
+        while True:
+            try:
+                return self._batch_queue.get(timeout=0.2)
+            except queue.Empty:
+                if not warned:
+                    log.warning(
+                        "Bucket input queue is empty when calling next_batch. "
+                        "Bucket queue size: %i, Input queue size: %i",
+                        self._batch_queue.qsize(), self._example_queue.qsize())
+                    warned = True
+                if self._single_pass and self._finished_reading and not any(
+                        t.is_alive() for t in self._batch_q_threads):
+                    if self._batch_queue.qsize() == 0:
+                        log.info("Finished reading dataset in single_pass mode.")
+                        return None
 
     # -- producers --
-    def _text_pairs(self) -> Iterator[Tuple[str, str]]:
+    def _text_pairs(self) -> Iterator[Tuple[str, ...]]:
+        """Yields (article, abstract) or, from a streaming source,
+        (uuid, article, abstract, reference) with passthrough columns
+        (the FlinkExample uuid field, reference batcher.py:398-410)."""
         if self._example_source is not None:
             yield from self._example_source()
             return
@@ -123,7 +132,7 @@ class Batcher:
         gen = self._text_pairs()
         while True:
             try:
-                article, abstract = next(gen)
+                item = next(gen)
             except StopIteration:
                 log.info("example generator exhausted data.")
                 if self._single_pass:
@@ -132,10 +141,15 @@ class Batcher:
                 raise Exception(
                     "single_pass mode is off but the example generator is "
                     "out of data; error.")
+            if len(item) == 4:
+                uuid, article, abstract, reference = item
+            else:
+                article, abstract = item
+                uuid, reference = "", ""
             abstract_sentences = [
                 s.strip() for s in oov_lib.abstract2sents(abstract)]
             ex = SummaryExample.build(article, abstract_sentences, self._vocab,
-                                      self._hps)
+                                      self._hps, uuid=uuid, reference=reference)
             self._example_queue.put(ex)
 
     def _get_example(self, timeout: Optional[float] = None) -> Optional[SummaryExample]:

@@ -1,0 +1,133 @@
+// Host-side streaming bridge: bounded byte-record queue (C ABI).
+//
+// TPU-native counterpart of the reference's native data plane — the
+// Flink-AI-Extended Java<->Python record queues (MLMapFunction read/write
+// queues, doc/Flink-AI-Extended Integration Report.md:887-941).  One
+// instance carries serialized tf.Example records one way between the
+// pipeline driver and a worker.
+//
+// Semantics:
+//   * bounded: put blocks (with optional timeout) while full;
+//   * immediate flush: every put signals the consumer before returning —
+//     the design fix for the reference's Issue-6 (a result only reached
+//     the sink when the NEXT record arrived, report:879-897);
+//   * end-of-stream: close() wakes everyone; drained gets return -1.
+//
+// Exposed through ctypes (pipeline/bridge.py NativeRecordQueue); the
+// PyRecordQueue fallback implements identical behavior.
+
+#include <sys/types.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+struct Queue {
+  std::mutex mu;
+  std::condition_variable not_empty;
+  std::condition_variable not_full;
+  std::deque<std::vector<unsigned char>> items;
+  size_t capacity;
+  bool closed = false;
+
+  explicit Queue(size_t cap) : capacity(cap == 0 ? 1 : cap) {}
+};
+
+bool wait_on(std::condition_variable& cv, std::unique_lock<std::mutex>& lk,
+             double timeout_s, bool (*done)(Queue*), Queue* q) {
+  if (timeout_s < 0) {
+    cv.wait(lk, [&] { return done(q); });
+    return true;
+  }
+  return cv.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                     [&] { return done(q); });
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tsb_queue_new(size_t capacity) {
+  return new (std::nothrow) Queue(capacity);
+}
+
+void tsb_queue_free(void* handle) {
+  delete static_cast<Queue*>(handle);
+}
+
+// 0 on success; -1 on timeout or closed queue.
+int tsb_queue_put(void* handle, const char* data, size_t len,
+                  double timeout_s) {
+  Queue* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  bool ok = wait_on(
+      q->not_full, lk, timeout_s,
+      [](Queue* qq) { return qq->closed || qq->items.size() < qq->capacity; },
+      q);
+  if (!ok || q->closed) return -1;
+  q->items.emplace_back(reinterpret_cast<const unsigned char*>(data),
+                        reinterpret_cast<const unsigned char*>(data) + len);
+  lk.unlock();
+  q->not_empty.notify_one();  // immediate flush: consumer wakes now
+  return 0;
+}
+
+// Returns record length (>= 0) with *out set to a malloc'd copy the caller
+// frees via tsb_record_free; -1 on closed-and-drained or timeout.
+ssize_t tsb_queue_get(void* handle, void** out, double timeout_s) {
+  Queue* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  bool ok = wait_on(
+      q->not_empty, lk, timeout_s,
+      [](Queue* qq) { return qq->closed || !qq->items.empty(); }, q);
+  if (!ok || q->items.empty()) {
+    *out = nullptr;
+    return -1;  // timeout, or closed and drained
+  }
+  std::vector<unsigned char> rec = std::move(q->items.front());
+  q->items.pop_front();
+  lk.unlock();
+  q->not_full.notify_one();
+  if (rec.empty()) {
+    *out = nullptr;
+    return 0;
+  }
+  void* buf = std::malloc(rec.size());
+  if (buf == nullptr) return -1;
+  std::memcpy(buf, rec.data(), rec.size());
+  *out = buf;
+  return static_cast<ssize_t>(rec.size());
+}
+
+void tsb_record_free(void* p) { std::free(p); }
+
+void tsb_queue_close(void* handle) {
+  Queue* q = static_cast<Queue*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+int tsb_queue_closed(void* handle) {
+  Queue* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->closed ? 1 : 0;
+}
+
+size_t tsb_queue_size(void* handle) {
+  Queue* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->items.size();
+}
+
+}  // extern "C"

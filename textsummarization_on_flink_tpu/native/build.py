@@ -1,0 +1,36 @@
+"""Build the native bridge shared library.
+
+Usage: python -m textsummarization_on_flink_tpu.native.build
+Produces libtsbridge.so next to bridge.cpp; pipeline/bridge.py picks it up
+automatically (NativeRecordQueue).  Pure-Python fallback exists, so the
+build is optional everywhere except performance-sensitive deployments.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "bridge.cpp")
+OUT = os.path.join(HERE, "libtsbridge.so")
+
+
+def build(force: bool = False) -> str:
+    if not force and os.path.exists(OUT) and \
+            os.path.getmtime(OUT) >= os.path.getmtime(SRC):
+        return OUT
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        raise RuntimeError("no C++ compiler found (need g++ or c++)")
+    cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           SRC, "-o", OUT]
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    print(path)

@@ -1,0 +1,6 @@
+from textsummarization_on_flink_tpu.pipeline import bridge  # noqa: F401
+from textsummarization_on_flink_tpu.pipeline import codec  # noqa: F401
+from textsummarization_on_flink_tpu.pipeline import estimator  # noqa: F401
+from textsummarization_on_flink_tpu.pipeline import io  # noqa: F401
+from textsummarization_on_flink_tpu.pipeline import params  # noqa: F401
+from textsummarization_on_flink_tpu.pipeline import app  # noqa: F401

@@ -1,0 +1,360 @@
+"""Estimator/Model pipeline stages (TFEstimator/TFModel parity, TPU-native).
+
+Rebuilds the reference's generic pipeline classes
+(/root/reference/src/main/java/org/apache/flink/table/ml/lib/tensorflow/
+TFEstimator.java, TFModel.java) without the Flink runtime:
+
+  * `SummarizationEstimator.fit(source) -> SummarizationModel` selects the
+    train columns (TFEstimator.java:32-38), parses the hyperparameter argv
+    string from the params (the `TF_Hyperparameter` hand-off,
+    TFEstimator.java:52 -> run_summarization.py:418-420), streams rows
+    through the bridge as serialized tf.Example records (the example-coding
+    data plane, CodingUtils.java), trains, and returns a model configured
+    with the inference params (TFEstimator.java:86-96).
+  * The returned model carries CONFIG ONLY — weights travel via the
+    checkpoint directory (`log_root/exp_name/train`), exactly like the
+    reference (SURVEY.md §3.1 "Important semantics").
+  * `SummarizationModel.transform(source, sink)` mirrors
+    TFModel.transform (TFModel.java:56-76): select inference cols
+    (uuid, article, reference), decode, emit
+    (uuid, article, summary, reference) rows — each flushed to the sink
+    immediately (the Issue-6 fix).
+  * `to_json()`/`load_json()` persist params-JSON only
+    (TensorFlowTest.testJsonExportImport, :142-168).
+
+Deliberate fix over the reference: fit() and transform() work in ONE
+process/job, so `Pipeline(estimator -> model)` composes — the reference
+could run only one TFUtils call per Flink job (Integration Report:9,
+260-282; TensorFlowTest.testPipeline's commented-out half, :170-202).
+
+Execution is eager (fit trains when called); the reference's lazy
+job-graph + streamEnv.execute() split has no Flink equivalent here.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+from typing import Any, Iterator, List, Optional, Tuple
+
+from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.batcher import Batcher
+from textsummarization_on_flink_tpu.data.vocab import (
+    SENTENCE_END,
+    SENTENCE_START,
+    Vocab,
+)
+from textsummarization_on_flink_tpu.decode.decoder import BeamSearchDecoder
+from textsummarization_on_flink_tpu.pipeline import bridge as bridge_lib
+from textsummarization_on_flink_tpu.pipeline import params as P
+from textsummarization_on_flink_tpu.pipeline.codec import ExampleCoding
+from textsummarization_on_flink_tpu.pipeline.io import (
+    CollectionSink,
+    Row,
+    RowSchema,
+    Sink,
+    Source,
+)
+from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+
+log = logging.getLogger(__name__)
+
+_SENT_RE = re.compile(r"(?<=[.!?])\s+")
+
+
+def sent_tokenize(text: str) -> List[str]:
+    """Sentence split for streamed reference summaries
+    (FlinkTrainBatcher's nltk sent_tokenize, batcher.py:643).  Tries nltk,
+    falls back to a punctuation split (nltk's punkt data may be absent)."""
+    text = text.strip()
+    if not text:
+        return []
+    try:  # pragma: no cover - depends on nltk data presence
+        import nltk
+
+        return nltk.tokenize.sent_tokenize(text)
+    except (ImportError, LookupError):
+        return [s for s in _SENT_RE.split(text) if s]
+
+
+def reference_to_abstract(reference: str) -> str:
+    """'<s> sent </s>'-wrap each sentence (batcher.py:642-644)."""
+    return " ".join(f"{SENTENCE_START} {s} {SENTENCE_END}"
+                    for s in sent_tokenize(reference))
+
+
+class PipelineStage(P.WithParams):
+    """Base with params-JSON persistence (PipelineStage.toJson parity)."""
+
+    def to_json(self) -> str:
+        return self.params.to_json()
+
+    def load_json(self, s: str) -> "PipelineStage":
+        self.load_params_json(s)  # typed re-validation of declared params
+        return self
+
+
+class Estimator(PipelineStage):
+    """flink-ml Estimator: fit(source) -> Model."""
+
+    def fit(self, source: Source) -> "Model":
+        raise NotImplementedError
+
+
+class Model(PipelineStage):
+    """flink-ml Model/Transformer: transform(source) -> rows."""
+
+    def transform(self, source: Source, sink: Optional[Sink] = None) -> Sink:
+        raise NotImplementedError
+
+
+class _BridgeFeeder:
+    """Driver-side feed pump: source rows -> coded records -> RecordQueue.
+
+    The reference equivalent is Flink streaming `Row`s into AI-Extended's
+    example-coding queue toward the python worker (SURVEY.md §2.6 item 3).
+
+    A source error (socket drop, bad JSON, Kafka failure) is captured and
+    re-raised on the CONSUMER side after the queue drains — a failed stream
+    must fail the job, not silently truncate the dataset.
+    """
+
+    def __init__(self, source: Source, selected_cols: List[str],
+                 coding: ExampleCoding, q: bridge_lib.RecordQueue):
+        self._source = source
+        self._cols = selected_cols
+        self._coding = coding
+        self._q = q
+        self.error: Optional[BaseException] = None
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> "_BridgeFeeder":
+        self.thread.start()
+        return self
+
+    def _run(self) -> None:
+        try:
+            for row in self._source.rows():
+                projected = self._source.schema.project_row(row, self._cols)
+                self._q.put(self._coding.encode(projected))
+        except BaseException as e:  # propagated via raise_if_failed
+            self.error = e
+            log.exception("source stream failed")
+        finally:
+            self._q.close()
+
+    def raise_if_failed(self) -> None:
+        if self.error is not None:
+            raise RuntimeError("source stream failed mid-job; partial data "
+                               "would corrupt the result") from self.error
+
+
+def _rows_from_queue(q: bridge_lib.RecordQueue, coding: ExampleCoding,
+                     ) -> Iterator[Row]:
+    while True:
+        rec = q.get(timeout=1.0)
+        if rec is None:
+            if q.closed and len(q) == 0:
+                return
+            continue
+        yield coding.decode(rec)
+
+
+class SummarizationModel(Model,
+                         P.HasClusterConfig,
+                         P.HasInferencePythonConfig,
+                         P.HasInferenceSelectedCols,
+                         P.HasInferenceOutputCols,
+                         P.HasInferenceOutputTypes):
+    """Generic inference stage (TFModel.java parity).
+
+    transform() consumes (uuid, article, reference) rows, beam-decodes each
+    article, and emits (uuid, article, summary, reference) — the
+    write_for_flink row (decode.py:159-185, flink_writer.py:22-34).
+    """
+
+    def __init__(self) -> None:
+        P.WithParams.__init__(self)
+        self._vocab_override: Optional[Vocab] = None
+
+    # test/embedding hook: skip reading vocab_path from disk
+    def with_vocab(self, vocab: Vocab) -> "SummarizationModel":
+        self._vocab_override = vocab
+        return self
+
+    def _hps(self) -> HParams:
+        argv = self.get_inference_hyper_params() or []
+        hps = HParams.from_string(" ".join(argv))
+        return hps.replace(mode="decode")
+
+    def _vocab(self, hps: HParams) -> Vocab:
+        if self._vocab_override is not None:
+            return self._vocab_override
+        return Vocab(hps.vocab_path, hps.vocab_size)
+
+    def transform(self, source: Source, sink: Optional[Sink] = None,
+                  max_batches: int = 0) -> Sink:
+        hps = self._hps()
+        hps.validate()
+        vocab = self._vocab(hps)
+        out_sink = sink if sink is not None else CollectionSink()
+        sel = self.get_inference_selected_cols()  # uuid, article, reference
+        in_schema = source.schema.select(sel)
+        coding = ExampleCoding(in_schema, in_schema)
+        q = bridge_lib.make_record_queue()
+        feeder = _BridgeFeeder(source, sel, coding, q).start()
+
+        def example_source():
+            for row in _rows_from_queue(q, coding):
+                uuid, article, reference = (str(row[0]), str(row[1]),
+                                            str(row[2]))
+                # inference has no gold abstract; reference text rides along
+                yield uuid, article, reference_to_abstract(reference), reference
+
+        batcher = Batcher("", vocab, hps, single_pass=True,
+                          decode_batch_mode="distinct",
+                          example_source=example_source)
+        train_dir = os.path.join(hps.log_root or ".", hps.exp_name or "exp",
+                                 "train")
+        decoder = BeamSearchDecoder(
+            hps.replace(single_pass=False), vocab, batcher,
+            train_dir=train_dir,
+            decode_root=os.path.join(hps.log_root or ".",
+                                     hps.exp_name or "exp"))
+        decoder.decode(result_sink=lambda res: out_sink.write(res.as_row()),
+                       max_batches=max_batches)
+        feeder.raise_if_failed()
+        return out_sink
+
+
+class SummarizationEstimator(Estimator,
+                             P.HasClusterConfig,
+                             P.HasTrainPythonConfig,
+                             P.HasInferencePythonConfig,
+                             P.HasTrainSelectedCols,
+                             P.HasTrainOutputCols,
+                             P.HasTrainOutputTypes,
+                             P.HasInferenceSelectedCols,
+                             P.HasInferenceOutputCols,
+                             P.HasInferenceOutputTypes):
+    """Generic trainable stage (TFEstimator.java parity)."""
+
+    def __init__(self) -> None:
+        P.WithParams.__init__(self)
+        self._vocab_override: Optional[Vocab] = None
+
+    def with_vocab(self, vocab: Vocab) -> "SummarizationEstimator":
+        self._vocab_override = vocab
+        return self
+
+    def _hps(self) -> HParams:
+        argv = self.get_train_hyper_params() or []
+        hps = HParams.from_string(" ".join(argv))
+        return hps.replace(mode="train")
+
+    def _vocab(self, hps: HParams) -> Vocab:
+        if self._vocab_override is not None:
+            return self._vocab_override
+        return Vocab(hps.vocab_path, hps.vocab_size)
+
+    def fit(self, source: Source) -> SummarizationModel:
+        hps = self._hps()
+        hps.validate()
+        vocab = self._vocab(hps)
+        sel = self.get_train_selected_cols()  # uuid, article, reference
+        in_schema = source.schema.select(sel)
+        coding = ExampleCoding(in_schema, in_schema)
+        q = bridge_lib.make_record_queue()
+        feeder = _BridgeFeeder(source, sel, coding, q).start()
+
+        def example_source():
+            for row in _rows_from_queue(q, coding):
+                uuid, article, reference = (str(row[0]), str(row[1]),
+                                            str(row[2]))
+                yield uuid, article, reference_to_abstract(reference), reference
+
+        batcher = Batcher("", vocab, hps, single_pass=True,
+                          example_source=example_source)
+        train_dir = os.path.join(hps.log_root or ".", hps.exp_name or "exp",
+                                 "train")
+        checkpointer = ckpt_lib.Checkpointer(train_dir, hps=hps)
+        prev = checkpointer.restore()
+        state = None
+        if prev is not None:
+            log.info("resuming training from step %d", int(prev.step))
+            state = prev
+        trainer = trainer_lib.Trainer(hps, vocab.size(), batcher,
+                                      state=state, checkpointer=checkpointer,
+                                      train_dir=train_dir)
+        trainer.train(num_steps=hps.num_steps)
+        feeder.raise_if_failed()
+
+        # configure the model with the inference side of our params
+        # (TFEstimator.java:86-96)
+        model = SummarizationModel()
+        model.set_coordinator_address(self.get_coordinator_address())
+        model.set_worker_num(self.get_worker_num())
+        model.set_ps_num(self.get_ps_num())
+        if self.get_inference_scripts() is not None:
+            model.set_inference_scripts(self.get_inference_scripts())
+        model.set_inference_map_func(self.get_inference_map_func())
+        model.set_inference_hyper_params_key(
+            self.get_inference_hyper_params_key())
+        if self.get_inference_hyper_params() is not None:
+            model.set_inference_hyper_params(self.get_inference_hyper_params())
+        if self.get_inference_env_path() is not None:
+            model.set_inference_env_path(self.get_inference_env_path())
+        model.set_inference_selected_cols(self.get_inference_selected_cols())
+        model.set_inference_output_cols(self.get_inference_output_cols())
+        model.set_inference_output_types(self.get_inference_output_types())
+        if self._vocab_override is not None:
+            model.with_vocab(self._vocab_override)
+        return model
+
+
+class Pipeline:
+    """Minimal Pipeline(stages) with appendStage/fit semantics — the thing
+    TensorFlowTest.testPipeline (:170-202) could only half-exercise; here
+    an Estimator inside a pipeline works because fit+transform share one
+    process."""
+
+    def __init__(self, stages: Optional[List[PipelineStage]] = None):
+        self.stages: List[PipelineStage] = list(stages or [])
+
+    def append_stage(self, stage: PipelineStage) -> "Pipeline":
+        self.stages.append(stage)
+        return self
+
+    def fit(self, source: Source) -> "Pipeline":
+        """Fit every estimator in order; transformers pass sources through
+        unchanged (the reference pipeline re-streams tables between
+        stages)."""
+        fitted: List[PipelineStage] = []
+        for stage in self.stages:
+            if isinstance(stage, Estimator):
+                fitted.append(stage.fit(source))
+            else:
+                fitted.append(stage)
+        return Pipeline(fitted)
+
+    def transform(self, source: Source, sink: Optional[Sink] = None) -> Sink:
+        """Chain every Model stage: each stage's output rows become the
+        next stage's source; the last stage writes into `sink`."""
+        from textsummarization_on_flink_tpu.pipeline.io import (
+            ARTICLE_OUTPUT_SCHEMA,
+            CollectionSource,
+        )
+
+        models = [s for s in self.stages if isinstance(s, Model)]
+        if not models:
+            raise ValueError("pipeline has no Model stage to transform with")
+        out = sink if sink is not None else CollectionSink()
+        cur_source = source
+        for stage in models[:-1]:
+            mid = stage.transform(cur_source, CollectionSink())
+            cur_source = CollectionSource(mid.rows,
+                                          schema=ARTICLE_OUTPUT_SCHEMA)
+        return models[-1].transform(cur_source, out)

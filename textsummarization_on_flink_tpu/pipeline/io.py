@@ -1,0 +1,318 @@
+"""Row schemas, wire-type mapping, sources/sinks, and the message codec.
+
+Rebuilds the reference's streaming I/O surface without the Flink runtime:
+
+  * `DataTypes`/`RowSchema`: the supported wire types and schema <-> codec
+    config mapping of CodingUtils.java:25-129 (STRING, BOOL, INT8/16/32/64,
+    FLOAT32/64, UINT16, FLOAT32_ARRAY; anything else raises).
+  * `Message`: the Kafka JSON payload (uuid, article, summary, reference)
+    of me/littlebo/Message.java:1-71.
+  * Sources: collection (test rows, TensorFlowTest.java:204-217), socket
+    (testInferenceFromSocket, TensorFlowTest.java:123-140), Kafka adapter
+    (App.java:134-152; optional dependency, gated), each with the
+    bounded-stream `max_count` semantics of
+    MessageDeserializationSchema.java:34-40.
+  * Sinks: collection, print (App.java:100), socket, Kafka — all flushed
+    per record: the reference's AI-Extended bridge only flushed a result
+    when the NEXT record arrived (Integration Report Issue 6, :879-941);
+    our sinks forward immediately by design.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import socket as socket_lib
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
+
+Row = Tuple[Any, ...]
+
+
+# --------------------------------------------------------------------------
+# Wire types (CodingUtils.java:25-129 support matrix)
+# --------------------------------------------------------------------------
+
+class DataTypes:
+    STRING = "STRING"
+    BOOL = "BOOL"
+    INT_8 = "INT_8"
+    INT_16 = "INT_16"
+    INT_32 = "INT_32"
+    INT_64 = "INT_64"
+    UINT_16 = "UINT_16"
+    FLOAT_32 = "FLOAT_32"
+    FLOAT_64 = "FLOAT_64"
+    FLOAT_32_ARRAY = "FLOAT_32_ARRAY"
+
+    _ALL = (STRING, BOOL, INT_8, INT_16, INT_32, INT_64, UINT_16,
+            FLOAT_32, FLOAT_64, FLOAT_32_ARRAY)
+    _INTS = (INT_8, INT_16, INT_32, INT_64, UINT_16, BOOL)
+    _FLOATS = (FLOAT_32, FLOAT_64)
+
+    @classmethod
+    def validate(cls, name: str) -> str:
+        if name not in cls._ALL:
+            # CodingUtils throws RuntimeException("Unsupported data type")
+            raise ValueError(f"Unsupported data type for example coding: {name}")
+        return name
+
+
+class RowSchema:
+    """Named, typed columns (TableSchema parity, CodingUtils.java:147-194)."""
+
+    def __init__(self, names: Sequence[str], types: Sequence[str]):
+        if len(names) != len(types):
+            raise ValueError("names/types length mismatch")
+        self.names = list(names)
+        self.types = [DataTypes.validate(t) for t in types]
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, RowSchema) and self.names == other.names
+                and self.types == other.types)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}:{t}" for n, t in zip(self.names, self.types))
+        return f"RowSchema({cols})"
+
+    def select(self, cols: Sequence[str]) -> "RowSchema":
+        idx = [self.names.index(c) for c in cols]
+        return RowSchema([self.names[i] for i in idx],
+                         [self.types[i] for i in idx])
+
+    def project_row(self, row: Row, cols: Sequence[str]) -> Row:
+        idx = [self.names.index(c) for c in cols]
+        return tuple(row[i] for i in idx)
+
+
+# The article-summarization row schemas (App.java:94,158-159)
+ARTICLE_INPUT_SCHEMA = RowSchema(
+    ["uuid", "article", "summary", "reference"], [DataTypes.STRING] * 4)
+ARTICLE_OUTPUT_SCHEMA = RowSchema(
+    ["uuid", "article", "summary", "reference"], [DataTypes.STRING] * 4)
+
+
+# --------------------------------------------------------------------------
+# Message codec (me/littlebo/Message.java + JSON schemas)
+# --------------------------------------------------------------------------
+
+class Message:
+    """Kafka JSON payload <-> Row(uuid, article, summary, reference)."""
+
+    def __init__(self, uuid: str = "", article: str = "", summary: str = "",
+                 reference: str = ""):
+        self.uuid = uuid
+        self.article = article
+        self.summary = summary
+        self.reference = reference
+
+    def to_row(self) -> Row:
+        return (self.uuid, self.article, self.summary, self.reference)
+
+    @classmethod
+    def from_row(cls, row: Row) -> "Message":
+        return cls(*[str(v) for v in row])
+
+    def to_json(self) -> str:
+        return json.dumps({"uuid": self.uuid, "article": self.article,
+                           "summary": self.summary,
+                           "reference": self.reference}, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Message":
+        d = json.loads(s)
+        return cls(uuid=d.get("uuid", ""), article=d.get("article", ""),
+                   summary=d.get("summary", ""),
+                   reference=d.get("reference", ""))
+
+
+# --------------------------------------------------------------------------
+# Sources
+# --------------------------------------------------------------------------
+
+class Source:
+    """A bounded or unbounded row stream."""
+
+    schema: RowSchema
+
+    def rows(self) -> Iterator[Row]:
+        raise NotImplementedError
+
+
+class CollectionSource(Source):
+    """In-memory rows (the 8-row synthetic tables of
+    TensorFlowTest.createArticleData, :204-217)."""
+
+    def __init__(self, rows: Sequence[Row], schema: Optional[RowSchema] = None):
+        self._rows = list(rows)
+        self.schema = schema or ARTICLE_INPUT_SCHEMA
+
+    def rows(self) -> Iterator[Row]:
+        return iter(self._rows)
+
+
+class SocketSource(Source):
+    """Line-JSON messages from a TCP socket
+    (testInferenceFromSocket, TensorFlowTest.java:123-140).
+
+    max_count bounds the stream like MessageDeserializationSchema's record
+    counter (:34-40) — the reference's hack to end a Kafka stream is a
+    first-class bound here.
+    """
+
+    def __init__(self, host: str, port: int, max_count: int = 0,
+                 schema: Optional[RowSchema] = None, timeout: float = 30.0):
+        self._host = host
+        self._port = port
+        self._max = max_count
+        self._timeout = timeout
+        self.schema = schema or ARTICLE_INPUT_SCHEMA
+
+    def rows(self) -> Iterator[Row]:
+        n = 0
+        with socket_lib.create_connection((self._host, self._port),
+                                          timeout=self._timeout) as sock:
+            # the timeout governs CONNECT only; a long-lived stream may
+            # legitimately idle between records indefinitely
+            sock.settimeout(None)
+            f = sock.makefile("r", encoding="utf-8")
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                yield Message.from_json(line).to_row()
+                n += 1
+                if self._max and n >= self._max:
+                    return
+
+
+class IteratorSource(Source):
+    """Wrap any row iterator/callable (streaming-bridge hook)."""
+
+    def __init__(self, it: Callable[[], Iterator[Row]],
+                 schema: Optional[RowSchema] = None):
+        self._it = it
+        self.schema = schema or ARTICLE_INPUT_SCHEMA
+
+    def rows(self) -> Iterator[Row]:
+        return self._it()
+
+
+class KafkaSource(Source):
+    """Kafka topic consumer (App.java:134-143). Optional dependency: raises
+    a clear error at use time when kafka-python is unavailable."""
+
+    def __init__(self, topic: str, bootstrap_servers: str = "localhost:9092",
+                 group_id: str = "summarization", max_count: int = 0,
+                 schema: Optional[RowSchema] = None):
+        self.topic = topic
+        self.bootstrap_servers = bootstrap_servers
+        self.group_id = group_id
+        self._max = max_count
+        self.schema = schema or ARTICLE_INPUT_SCHEMA
+
+    def rows(self) -> Iterator[Row]:
+        try:
+            from kafka import KafkaConsumer  # type: ignore
+        except ImportError as e:  # pragma: no cover - env without kafka
+            raise RuntimeError(
+                "KafkaSource needs the kafka-python package; use "
+                "CollectionSource/SocketSource or install kafka-python") from e
+        consumer = KafkaConsumer(
+            self.topic, bootstrap_servers=self.bootstrap_servers,
+            group_id=self.group_id, value_deserializer=lambda b: b)
+        n = 0
+        for msg in consumer:  # pragma: no cover - needs a broker
+            yield Message.from_json(msg.value.decode("utf-8")).to_row()
+            n += 1
+            if self._max and n >= self._max:
+                return
+
+
+# --------------------------------------------------------------------------
+# Sinks (all flush per record — the Issue-6 fix)
+# --------------------------------------------------------------------------
+
+class Sink:
+    def write(self, row: Row) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class CollectionSink(Sink):
+    def __init__(self) -> None:
+        self.rows: List[Row] = []
+        self._lock = threading.Lock()
+
+    def write(self, row: Row) -> None:
+        with self._lock:
+            self.rows.append(row)
+
+
+class PrintSink(Sink):
+    """print().setParallelism(1) parity (App.java:100,121,129)."""
+
+    def write(self, row: Row) -> None:
+        print(row, flush=True)
+
+
+class SocketSink(Sink):
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket_lib.create_connection((host, port), timeout=timeout)
+
+    def write(self, row: Row) -> None:
+        data = (Message.from_row(row).to_json() + "\n").encode("utf-8")
+        self._sock.sendall(data)  # immediate flush
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class KafkaSink(Sink):
+    """Kafka topic producer (App.java:145-152); optional dependency."""
+
+    def __init__(self, topic: str, bootstrap_servers: str = "localhost:9092"):
+        self.topic = topic
+        self.bootstrap_servers = bootstrap_servers
+        self._producer = None
+
+    def _ensure(self):
+        if self._producer is None:
+            try:
+                from kafka import KafkaProducer  # type: ignore
+            except ImportError as e:  # pragma: no cover
+                raise RuntimeError(
+                    "KafkaSink needs the kafka-python package") from e
+            self._producer = KafkaProducer(
+                bootstrap_servers=self.bootstrap_servers)
+        return self._producer
+
+    def write(self, row: Row) -> None:  # pragma: no cover - needs a broker
+        p = self._ensure()
+        p.send(self.topic, Message.from_row(row).to_json().encode("utf-8"))
+        p.flush()  # immediate flush
+
+    def close(self) -> None:  # pragma: no cover
+        if self._producer is not None:
+            self._producer.close()
+
+
+class QueueSink(Sink):
+    """Push rows into a thread-safe queue (bridge glue)."""
+
+    def __init__(self, q: Optional["queue.Queue[Row]"] = None):
+        self.queue: "queue.Queue[Row]" = q if q is not None else queue.Queue()
+
+    def write(self, row: Row) -> None:
+        self.queue.put(row)
