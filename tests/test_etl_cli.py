@@ -1,0 +1,172 @@
+"""ETL pipeline (make_datafiles parity) + CLI mode dispatch end-to-end."""
+
+import collections
+import os
+
+import pytest
+
+from textsummarization_on_flink_tpu import cli
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data import chunks, etl
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+
+
+# -- tokenizer ---------------------------------------------------------------
+
+def test_word_tokenize_punctuation_and_contractions():
+    toks = etl.word_tokenize("Don't stop the U.S. team, it's 1,000.5 mi-les!")
+    assert "n't" in toks and "Do" in toks
+    assert "u.s." in [t.lower() for t in toks]
+    assert "1,000.5" in toks
+    assert "," in toks and "!" in toks
+    assert "mi-les" in toks
+
+
+def test_fix_missing_period():
+    assert etl.fix_missing_period("headline here") == "headline here ."
+    assert etl.fix_missing_period("done.") == "done."
+    assert etl.fix_missing_period("quote”") == "quote”"
+    assert etl.fix_missing_period("@highlight") == "@highlight"
+    assert etl.fix_missing_period("") == ""
+
+
+def test_get_art_abs():
+    story = ("The Quick Brown Fox jumped\n\n@highlight\n\nFox Jumps\n\n"
+             "@highlight\n\nDog Sleeps.")
+    article, abstract = etl.get_art_abs(story)
+    assert article == "the quick brown fox jumped ."
+    assert abstract == "<s> fox jumps . </s> <s> dog sleeps . </s>"
+
+
+def test_hashhex_stable():
+    # sha1 of a known string (make_datafiles hashhex)
+    assert etl.hashhex("abc") == "a9993e364706816aba3e25717850c26c9cd0d89d"
+
+
+# -- write_to_bin / vocab / chunking -----------------------------------------
+
+@pytest.fixture
+def stories(tmp_path):
+    paths = []
+    for i in range(5):
+        p = tmp_path / f"story{i}.story"
+        p.write_text(f"the cat number {i} sat down\n\n@highlight\n\ncat {i} sat")
+        paths.append(str(p))
+    return paths
+
+
+def test_write_to_bin_round_trip(tmp_path, stories):
+    counter = collections.Counter()
+    out = etl.write_to_bin(stories, str(tmp_path / "train"), makevocab=True,
+                           vocab_counter=counter, chunk_size=2)
+    assert len(out) == 3  # 5 examples, chunk_size 2
+    exs = list(chunks.example_generator(str(tmp_path / "train_*.bin"),
+                                        single_pass=True))
+    assert len(exs) == 5
+    assert exs[0].get_str("article").startswith("the cat number")
+    assert "<s>" in exs[0].get_str("abstract")
+    assert counter["cat"] == 10  # article + abstract per story
+    assert "<s>" not in counter  # specials excluded from vocab
+
+
+def test_make_datafiles_full_pipeline(tmp_path, stories):
+    url_dir = tmp_path / "urls"
+    stories_dir = tmp_path / "hashed"
+    url_dir.mkdir()
+    stories_dir.mkdir()
+    urls = {"train": ["http://a/0", "http://a/1", "http://a/2"],
+            "val": ["http://a/3"], "test": ["http://a/4"]}
+    for i, (split, us) in enumerate(urls.items()):
+        (url_dir / f"all_{split}.txt").write_text("\n".join(us) + "\n")
+    for i, u in enumerate(u for us in urls.values() for u in us):
+        h = etl.hashhex(u)
+        (stories_dir / f"{h}.story").write_text(
+            open(stories[i]).read())
+    out_dir = tmp_path / "finished"
+    etl.make_datafiles(str(stories_dir), str(url_dir), str(out_dir))
+    assert os.path.exists(out_dir / "train_000.bin")
+    assert os.path.exists(out_dir / "val_000.bin")
+    assert os.path.exists(out_dir / "test_000.bin")
+    vocab_lines = (out_dir / "vocab").read_text().splitlines()
+    assert all(len(l.split()) == 2 for l in vocab_lines)
+    # vocab usable by Vocab
+    v = Vocab(str(out_dir / "vocab"))
+    assert v.size() > 4
+
+
+def test_missing_story_raises(tmp_path):
+    url_dir = tmp_path / "urls"
+    url_dir.mkdir()
+    for split in ("train", "val", "test"):
+        (url_dir / f"all_{split}.txt").write_text("http://missing\n")
+    with pytest.raises(FileNotFoundError):
+        etl.make_datafiles(str(tmp_path), str(url_dir), str(tmp_path / "o"))
+
+
+# -- CLI ---------------------------------------------------------------------
+
+WORDS = ("the cat number sat down quick brown fox jumped over lazy dog "
+         "0 1 2 3 4").split()
+
+
+@pytest.fixture
+def data_env(tmp_path, stories):
+    counter = collections.Counter()
+    etl.write_to_bin(stories, str(tmp_path / "train"), makevocab=True,
+                     vocab_counter=counter)
+    etl.write_vocab(counter, str(tmp_path / "vocab"))
+    return tmp_path
+
+
+def cli_argv(tmp_path, mode, **kw):
+    base = dict(mode=mode, data_path=str(tmp_path / "train_*.bin"),
+                vocab_path=str(tmp_path / "vocab"), log_root=str(tmp_path),
+                exp_name="exp", batch_size=2, hidden_dim=8, emb_dim=6,
+                vocab_size=20, max_enc_steps=10, max_dec_steps=5,
+                beam_size=2, min_dec_steps=1, max_oov_buckets=4)
+    base.update(kw)
+    return [f"--{k}={v}" for k, v in base.items()]
+
+
+def test_cli_train_then_eval_then_decode(data_env):
+    assert cli.main(cli_argv(data_env, "train", num_steps=2,
+                             single_pass=True)) == 0
+    train_dir = os.path.join(str(data_env), "exp", "train")
+    assert any(f.startswith("model.ckpt") for f in os.listdir(train_dir))
+
+    hps = HParams.from_argv(cli_argv(data_env, "eval"))
+    vocab = Vocab(hps.vocab_path, hps.vocab_size)
+    loss = cli.run_eval(hps, vocab, max_iters=2)
+    assert loss > 0
+    eval_dir = os.path.join(str(data_env), "exp", "eval")
+    assert any(f.startswith("bestmodel") for f in os.listdir(eval_dir))
+
+    assert cli.main(cli_argv(data_env, "decode", single_pass=True)) == 0
+    decode_dirs = [d for d in os.listdir(os.path.join(str(data_env), "exp"))
+                   if d.startswith("decode_")]
+    assert decode_dirs
+    assert os.path.exists(os.path.join(str(data_env), "exp", decode_dirs[0],
+                                       "ROUGE_results.txt"))
+
+
+def test_cli_surgery_flags(data_env):
+    cli.main(cli_argv(data_env, "train", num_steps=1, single_pass=True))
+    assert cli.main(cli_argv(data_env, "train",
+                             convert_to_coverage_model=True)) == 0
+    train_dir = os.path.join(str(data_env), "exp", "train")
+    assert any("_cov_init" in f for f in os.listdir(train_dir))
+
+
+def test_cli_raw_text_inference(data_env, tmp_path):
+    cli.main(cli_argv(data_env, "train", num_steps=1, single_pass=True))
+    raw_dir = tmp_path / "raw"
+    raw_dir.mkdir(exist_ok=True)
+    (raw_dir / "a.txt").write_text("the quick brown fox jumped over the dog")
+    argv = cli_argv(data_env, "decode", inference=True,
+                    data_path=str(raw_dir / "*.txt"))
+    assert cli.main(argv) == 0
+
+
+def test_cli_bad_mode_raises(data_env):
+    with pytest.raises(ValueError):
+        cli.main(cli_argv(data_env, "bogus"))

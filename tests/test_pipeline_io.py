@@ -189,3 +189,24 @@ def test_bridge_bounded_put_timeout(record_queue):
     for i in range(4):
         assert q.put(b"x")
     assert not q.put(b"overflow", timeout=0.1)  # full
+
+
+def test_bridge_close_wakes_blocked_producer(record_queue):
+    """close() must unblock a producer parked in a full-queue put()
+    (semantics parity between native and python implementations)."""
+    q = record_queue
+    for _ in range(4):
+        assert q.put(b"fill")
+    result = []
+
+    def producer():
+        result.append(q.put(b"blocked"))  # parks: queue full
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.1)
+    assert t.is_alive()  # parked in put
+    q.close()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert result == [False]

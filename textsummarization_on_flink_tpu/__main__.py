@@ -1,0 +1,3 @@
+from textsummarization_on_flink_tpu.cli import main
+
+raise SystemExit(main())

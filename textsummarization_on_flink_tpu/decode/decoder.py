@@ -206,7 +206,8 @@ class BeamSearchDecoder:
 
     def decode(self, with_rouge: bool = True,
                result_sink: Optional[Callable[[DecodedResult], None]] = None,
-               max_batches: int = 0) -> Optional[Dict[str, Dict[str, float]]]:
+               max_batches: int = 0, log_results: bool = True,
+               ) -> Optional[Dict[str, Dict[str, float]]]:
         """The main loop (decode.py:131-157).
 
         single_pass: decode everything once, write rouge files, then
@@ -214,6 +215,11 @@ class BeamSearchDecoder:
         batcher ends / max_batches), pushing results to `result_sink`
         immediately — no buffering, the Issue-6 fix — reloading fresh
         checkpoints every 60s.
+
+        log_results=False suppresses the continuous-mode article/summary
+        INFO logging and the per-result attn_vis_data.json rewrite — the
+        serving path (pipeline transform) wants results through the sink
+        only, not an unbounded per-record disk write.
         """
         t_last = time.time()
         counter = 0
@@ -235,7 +241,7 @@ class BeamSearchDecoder:
                 if self._hps.single_pass:
                     self.write_for_rouge(res, counter)
                     counter += 1
-                else:
+                elif log_results:
                     log.info("ARTICLE: %s", res.article)
                     log.info("GENERATED SUMMARY: %s", res.summary)
                     self.write_for_attnvis(res)

@@ -91,19 +91,24 @@ ssize_t tsb_queue_get(void* handle, void** out, double timeout_s) {
     *out = nullptr;
     return -1;  // timeout, or closed and drained
   }
-  std::vector<unsigned char> rec = std::move(q->items.front());
+  // Allocate the out-buffer BEFORE popping: on allocation failure the
+  // record stays queued instead of vanishing from the stream.
+  const std::vector<unsigned char>& front = q->items.front();
+  void* buf = nullptr;
+  if (!front.empty()) {
+    buf = std::malloc(front.size());
+    if (buf == nullptr) {
+      *out = nullptr;
+      return -1;
+    }
+    std::memcpy(buf, front.data(), front.size());
+  }
+  ssize_t n = static_cast<ssize_t>(front.size());
   q->items.pop_front();
   lk.unlock();
   q->not_full.notify_one();
-  if (rec.empty()) {
-    *out = nullptr;
-    return 0;
-  }
-  void* buf = std::malloc(rec.size());
-  if (buf == nullptr) return -1;
-  std::memcpy(buf, rec.data(), rec.size());
   *out = buf;
-  return static_cast<ssize_t>(rec.size());
+  return n;
 }
 
 void tsb_record_free(void* p) { std::free(p); }

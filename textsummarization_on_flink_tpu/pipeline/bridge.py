@@ -28,12 +28,14 @@ reference's per-task queue pair).
 
 from __future__ import annotations
 
+import collections
 import ctypes
 import logging
 import os
-import queue
 import threading
 from typing import Optional
+
+_deque = collections.deque
 
 log = logging.getLogger(__name__)
 
@@ -64,41 +66,50 @@ class RecordQueue:
 
 
 class PyRecordQueue(RecordQueue):
+    """Condition-variable deque mirroring the C++ implementation exactly —
+    including close() waking a producer parked in a full-queue put()."""
+
     def __init__(self, capacity: int = 1024):
-        self._q: "queue.Queue[bytes]" = queue.Queue(maxsize=capacity)
-        self._closed = threading.Event()
+        self._items: "collections.deque[bytes]" = _deque()
+        self._capacity = max(capacity, 1)
+        self._cond = threading.Condition()
+        self._closed = False
 
     def put(self, data: bytes, timeout: Optional[float] = None) -> bool:
-        if self._closed.is_set():
-            return False
-        try:
-            self._q.put(bytes(data), timeout=timeout)
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self._closed or len(self._items) < self._capacity,
+                timeout=timeout)
+            if not ok or self._closed:
+                return False
+            self._items.append(bytes(data))
+            self._cond.notify_all()  # immediate flush
             return True
-        except queue.Full:
-            return False
 
     def get(self, timeout: Optional[float] = None) -> Optional[bytes]:
-        deadline_step = 0.05
-        waited = 0.0
-        while True:
-            try:
-                return self._q.get(timeout=deadline_step)
-            except queue.Empty:
-                if self._closed.is_set() and self._q.qsize() == 0:
-                    return None
-                waited += deadline_step
-                if timeout is not None and waited >= timeout:
-                    return None
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self._closed or len(self._items) > 0,
+                timeout=timeout)
+            if not ok or not self._items:
+                return None  # timeout, or closed-and-drained
+            rec = self._items.popleft()
+            self._cond.notify_all()
+            return rec
 
     def close(self) -> None:
-        self._closed.set()
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()  # wakes parked producers AND consumers
 
     @property
     def closed(self) -> bool:
-        return self._closed.is_set()
+        with self._cond:
+            return self._closed
 
     def __len__(self) -> int:
-        return self._q.qsize()
+        with self._cond:
+            return len(self._items)
 
 
 class NativeRecordQueue(RecordQueue):

@@ -139,12 +139,26 @@ class _BridgeFeeder:
         try:
             for row in self._source.rows():
                 projected = self._source.schema.project_row(row, self._cols)
-                self._q.put(self._coding.encode(projected))
+                if not self._q.put(self._coding.encode(projected)):
+                    if self._q.closed:  # consumer finished early: cancel
+                        log.info("record queue closed by consumer; "
+                                 "cancelling source stream")
+                        return
         except BaseException as e:  # propagated via raise_if_failed
             self.error = e
             log.exception("source stream failed")
         finally:
             self._q.close()
+
+    def finish(self) -> None:
+        """Cancel any remaining stream, reap the thread, surface errors.
+        Callers run this after the consumer stops (early or not) so a
+        stopped job neither leaks the feeder nor hides a source failure."""
+        self._q.close()
+        self.thread.join(timeout=10.0)
+        if self.thread.is_alive():  # pragma: no cover - defensive
+            log.warning("bridge feeder did not stop within 10s")
+        self.raise_if_failed()
 
     def raise_if_failed(self) -> None:
         if self.error is not None:
@@ -224,9 +238,12 @@ class SummarizationModel(Model,
             train_dir=train_dir,
             decode_root=os.path.join(hps.log_root or ".",
                                      hps.exp_name or "exp"))
-        decoder.decode(result_sink=lambda res: out_sink.write(res.as_row()),
-                       max_batches=max_batches)
-        feeder.raise_if_failed()
+        try:
+            decoder.decode(
+                result_sink=lambda res: out_sink.write(res.as_row()),
+                max_batches=max_batches, log_results=False)
+        finally:
+            feeder.finish()
         return out_sink
 
 
@@ -289,8 +306,10 @@ class SummarizationEstimator(Estimator,
         trainer = trainer_lib.Trainer(hps, vocab.size(), batcher,
                                       state=state, checkpointer=checkpointer,
                                       train_dir=train_dir)
-        trainer.train(num_steps=hps.num_steps)
-        feeder.raise_if_failed()
+        try:
+            trainer.train(num_steps=hps.num_steps)
+        finally:
+            feeder.finish()
 
         # configure the model with the inference side of our params
         # (TFEstimator.java:86-96)
