@@ -91,6 +91,11 @@ def run_decode(hps: HParams, vocab: Vocab,
     decode_hps = hps.replace(mode="decode")
     if batcher is None:
         if hps.inference:
+            # Deliberate divergence: the reference keeps the process alive
+            # after a non-single_pass raw-text run drains its (finite) file
+            # glob, blocked forever in next_batch (batcher.py:382-395 ends
+            # the fill thread without marking completion).  We treat the
+            # glob as one bounded pass and exit cleanly either way.
             batcher = Batcher("", vocab, decode_hps, single_pass=True,
                               example_source=raw_text_example_source(
                                   hps.data_path))

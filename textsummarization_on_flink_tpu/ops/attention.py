@@ -21,6 +21,8 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from textsummarization_on_flink_tpu.ops import pallas_attention
+
 Array = jax.Array
 
 
@@ -60,14 +62,14 @@ def attend(attn_params: Dict[str, Array], enc_states: Array, enc_feats: Array,
     c, h = dec_state
     dec_in = jnp.concatenate([c, h], axis=-1)
     dec_feats = dec_in @ attn_params["linear_kernel"] + attn_params["linear_bias"]
-    feats = enc_feats + dec_feats[:, None, :]
-    if use_coverage and coverage is not None:
-        # w_c is a length-D vector: coverage scalar at position i scales it
-        # (the reference's (1,1,1,D) conv2d over [B,T,1,1], :103-108)
-        feats = feats + coverage[:, :, None] * attn_params["w_c"][None, None, :]
-    e = jnp.sum(attn_params["v"] * jnp.tanh(feats), axis=-1)  # [B, T]
-    attn_dist = masked_softmax(e, enc_mask)
-    context = jnp.einsum("bt,btd->bd", attn_dist, enc_states)
+    # energy + masked softmax + context fused (Pallas on TPU, XLA elsewhere;
+    # energy-level masking is algebraically identical to the reference's
+    # softmax->mask->renorm pipeline)
+    apply_cov = bool(use_coverage and coverage is not None)
+    cov_in = coverage if apply_cov else jnp.zeros_like(enc_mask)
+    context, attn_dist = pallas_attention.fused_attention(
+        enc_states, enc_feats, enc_mask, dec_feats.astype(jnp.float32),
+        cov_in, attn_params["v"], attn_params["w_c"], apply_cov)
     new_coverage = None
     if use_coverage:
         new_coverage = (coverage if coverage is not None else 0.0) + attn_dist
