@@ -105,3 +105,25 @@ def test_attend_still_satisfies_model_contract():
     np.testing.assert_allclose(np.asarray(new_cov),
                                np.asarray(cov + attn), atol=1e-7)
     np.testing.assert_allclose(np.asarray(attn).sum(1), 1.0, atol=1e-5)
+
+
+@pytest.mark.parametrize("use_coverage", [False, True])
+def test_blocked_kernel_matches_xla_reference(use_coverage):
+    """Flash-style T-blocked variant (long-context path) vs reference."""
+    args = make_inputs(B=2, T=300, D=16, seed=5)
+    ctx_ref, attn_ref = pa._attention_xla(*args, use_coverage)
+    ctx_k, attn_k = pa._attention_pallas_blocked(
+        *args, use_coverage, block_t=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(ctx_k), np.asarray(ctx_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(attn_k), np.asarray(attn_ref),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_blocked_kernel_long_sequence_distribution():
+    args = make_inputs(B=1, T=1000, D=8, seed=6, frac_valid=0.9)
+    _, attn = pa._attention_pallas_blocked(*args, True, block_t=256,
+                                           interpret=True)
+    attn = np.asarray(attn)
+    np.testing.assert_allclose(attn.sum(axis=1), 1.0, atol=1e-4)
+    assert (attn[:, 900:] == 0).all()

@@ -67,3 +67,47 @@ def test_default_hps_match_reference_app():
     assert app_lib.TRAIN_TOPIC == "flink_train"
     assert app_lib.INPUT_TOPIC == "flink_input"
     assert app_lib.OUTPUT_TOPIC == "flink_output"
+
+
+def test_streaming_latency_timed_source(tmp_path):
+    """SourceSinkTest.java parity: a trickle stream must yield each result
+    promptly — a row's summary cannot wait for later rows to arrive
+    (the reference's Issue-6 flush bug, Integration Report:879-941)."""
+    import time as time_lib
+
+    from textsummarization_on_flink_tpu.pipeline.io import (
+        ARTICLE_INPUT_SCHEMA,
+        Sink,
+        Source,
+    )
+
+    vocab = Vocab(words=WORDS)
+    app = app_lib.App(train_hps=tiny_hps(tmp_path, "train", num_steps=1),
+                      inference_hps=tiny_hps(tmp_path, "decode"),
+                      vocab=vocab)
+    model_json = app.start_training(CollectionSource(rows(4)))
+    # warm the jit cache so the timed phase measures steady-state latency
+    app.start_inference(model_json, source=CollectionSource(rows(2)),
+                        sink=CollectionSink())
+
+    emit_times = {}
+    arrive_times = {}
+
+    class TimedSource(Source):
+        schema = ARTICLE_INPUT_SCHEMA
+
+        def rows(self):
+            for i, r in enumerate(rows(3)):
+                emit_times[r[0]] = time_lib.time()
+                yield r
+                time_lib.sleep(1.5)
+
+    class TimedSink(Sink):
+        def write(self, row):
+            arrive_times[row[0]] = time_lib.time()
+
+    app.start_inference(model_json, source=TimedSource(), sink=TimedSink())
+    assert len(arrive_times) == 3
+    # row 0's summary must land before row 2 was even emitted (3s in):
+    assert arrive_times["uuid-0"] < emit_times["uuid-2"], (
+        emit_times, arrive_times)

@@ -153,12 +153,17 @@ class Batcher:
             self._example_queue.put(ex)
 
     def _get_example(self, timeout: Optional[float] = None) -> Optional[SummaryExample]:
-        """example_queue.get that gives up once a single_pass read finished."""
+        """example_queue.get that gives up once a single_pass read finished,
+        or after `timeout` seconds (None = wait indefinitely)."""
+        waited = 0.0
         while True:
             try:
                 return self._example_queue.get(timeout=0.2)
             except queue.Empty:
                 if self._single_pass and self._finished_reading:
+                    return None
+                waited += 0.2
+                if timeout is not None and waited >= timeout:
                     return None
 
     def _fill_batch_queue(self) -> None:
@@ -193,13 +198,18 @@ class Batcher:
                 self._batch_queue.put(Batch(b, hps, self._vocab))
             else:  # 'distinct': fill a whole batch of different articles
                 exs = []
-                for _ in range(hps.batch_size):
-                    ex = self._get_example()
+                first = self._get_example()  # wait for the first article
+                if first is None:
+                    break
+                exs.append(first)
+                # Trickle-latency guard: top up briefly, then ship a
+                # partial batch padded with repeats — a streamed article
+                # must not wait for batch_size-1 neighbors to arrive.
+                while len(exs) < hps.batch_size:
+                    ex = self._get_example(timeout=0.2)
                     if ex is None:
                         break
                     exs.append(ex)
-                if not exs:
-                    break
                 while len(exs) < hps.batch_size:
                     exs.append(exs[-1])
                 self._batch_queue.put(Batch(exs, hps, self._vocab))

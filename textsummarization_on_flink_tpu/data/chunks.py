@@ -63,17 +63,47 @@ def example_generator(data_path: str, single_pass: bool,
             break
 
 
+def chunk_path(prefix: str, index: int, total_chunks: int = 0) -> str:
+    """The one chunk-file naming contract (make_datafiles.py:42 scheme):
+    `<prefix>_NNN.bin`, width >= 3 and wide enough that lexicographic
+    order equals numeric order."""
+    width = max(3, len(str(max(total_chunks - 1, index))))
+    return f"{prefix}_{index:0{width}d}.bin"
+
+
 def write_chunked(prefix: str, examples: List[Example],
                   chunk_size: int = 1000) -> List[str]:
     """Write examples into `<prefix>_000.bin`, `<prefix>_001.bin`, ...
     (make_datafiles.py:36-64 chunking scheme)."""
     n_chunks = max((len(examples) + chunk_size - 1) // chunk_size, 1)
-    width = max(3, len(str(n_chunks - 1)))  # keep lexicographic == numeric order
     paths = []
     for i in range(0, max(len(examples), 1), chunk_size):
-        path = f"{prefix}_{i // chunk_size:0{width}d}.bin"
+        path = chunk_path(prefix, i // chunk_size, n_chunks)
         write_chunk_file(path, examples[i : i + chunk_size])
         paths.append(path)
+    return paths
+
+
+def write_chunked_iter(prefix: str, examples: Iterable[Example],
+                       chunk_size: int = 1000,
+                       total_chunks: int = 0) -> List[str]:
+    """Streaming write_chunked: O(chunk_size) memory for arbitrarily large
+    example iterables (the CNN/DM train split is ~287k stories)."""
+    paths: List[str] = []
+    pending: List[Example] = []
+
+    def flush() -> None:
+        path = chunk_path(prefix, len(paths), total_chunks)
+        write_chunk_file(path, pending)
+        paths.append(path)
+        pending.clear()
+
+    for ex in examples:
+        pending.append(ex)
+        if len(pending) >= chunk_size:
+            flush()
+    if pending or not paths:
+        flush()
     return paths
 
 

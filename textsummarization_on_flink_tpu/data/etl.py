@@ -148,33 +148,24 @@ def write_to_bin(story_paths: List[str], out_prefix: str,
     Streams one chunk at a time (O(chunk_size) memory — the full CNN/DM
     train split is ~287k stories, far too large to hold as Examples).
     """
+
+    def examples():
+        for path in story_paths:
+            with open(path, "r", encoding="utf-8") as f:
+                ex = story_to_example(f.read(), tokenize=tokenize)
+            if makevocab and vocab_counter is not None:
+                art = ex.get_str("article")
+                abs_ = ex.get_str("abstract")
+                tokens = art.split() + [
+                    t for t in abs_.split()
+                    if t not in (SENTENCE_START, SENTENCE_END)]
+                vocab_counter.update(t.strip() for t in tokens if t.strip())
+            yield ex
+
     n_chunks = max((len(story_paths) + chunk_size - 1) // chunk_size, 1)
-    width = max(3, len(str(n_chunks - 1)))
-    paths_out: List[str] = []
-    pending: List[Example] = []
-
-    def flush() -> None:
-        path = f"{out_prefix}_{len(paths_out):0{width}d}.bin"
-        chunks.write_chunk_file(path, pending)
-        paths_out.append(path)
-        pending.clear()
-
-    for path in story_paths:
-        with open(path, "r", encoding="utf-8") as f:
-            ex = story_to_example(f.read(), tokenize=tokenize)
-        pending.append(ex)
-        if makevocab and vocab_counter is not None:
-            art = ex.get_str("article")
-            abs_ = ex.get_str("abstract")
-            tokens = art.split() + [
-                t for t in abs_.split()
-                if t not in (SENTENCE_START, SENTENCE_END)]
-            vocab_counter.update(t.strip() for t in tokens if t.strip())
-        if len(pending) >= chunk_size:
-            flush()
-    if pending or not paths_out:
-        flush()
-    return paths_out
+    return chunks.write_chunked_iter(out_prefix, examples(),
+                                     chunk_size=chunk_size,
+                                     total_chunks=n_chunks)
 
 
 def write_vocab(counter: collections.Counter, path: str,

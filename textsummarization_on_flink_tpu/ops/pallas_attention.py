@@ -138,6 +138,124 @@ def _attention_pallas(enc_states, enc_feats, enc_mask, dec_feats, coverage,
     return ctx[:, :D], attn[:, :T]
 
 
+def _blocked_kernel(es_ref, ef_ref, mask_ref, df_ref, cov_ref, v_ref, wc_ref,
+                    ctx_ref, p_ref, mblk_ref, stat_ref,
+                    m_scr, l_scr, ctx_scr, *, use_coverage: bool):
+    """Flash-style online-softmax block: grid (B, nT), T-blocks sequential.
+
+    Writes unnormalized p per block plus the running max it was computed
+    against (mblk) and final (m, l) stats; the wrapper applies the
+    correction  a_j = p_j * exp(mblk_j - m_fin) / l_fin  in XLA.  The
+    context accumulates in VMEM scratch with the usual rescaling.
+    """
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    nT = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[0] = NEG
+        l_scr[0] = 0.0
+        ctx_scr[:, :] = jnp.zeros_like(ctx_scr)
+
+    ef = ef_ref[0]  # [Tb, D]
+    df = df_ref[0]  # [D]
+    feats = ef + df[None, :]
+    if use_coverage:
+        feats = feats + cov_ref[0][:, None] * wc_ref[0][None, :]
+    e = jnp.sum(v_ref[0][None, :] * jnp.tanh(feats), axis=-1)  # [Tb]
+    mask = mask_ref[0]
+    e = jnp.where(mask > 0, e, NEG)
+
+    m_old = m_scr[0]
+    m_new = jnp.maximum(m_old, jnp.max(e))
+    scale = jnp.exp(m_old - m_new)
+    p = jnp.exp(e - m_new) * (mask > 0)
+    l_scr[0] = l_scr[0] * scale + jnp.sum(p)
+    ctx_scr[:, :] = ctx_scr[:, :] * scale + jnp.dot(
+        p[None, :], es_ref[0], preferred_element_type=jnp.float32)
+    m_scr[0] = m_new
+
+    p_ref[0, :] = p
+    mblk_ref[0, 0] = m_new
+
+    @pl.when(j == nT - 1)
+    def _finish():
+        ctx_ref[0, :] = ctx_scr[0, :] / l_scr[0]
+        stat_ref[0, 0] = m_scr[0]
+        stat_ref[0, 1] = l_scr[0]
+
+
+def _attention_pallas_blocked(enc_states, enc_feats, enc_mask, dec_feats,
+                              coverage, v, w_c, use_coverage,
+                              block_t: int = 512, interpret=False):
+    """Long-context path: stream T in `block_t` slices (VMEM holds one
+    [block_t, D] slice at a time), online softmax across blocks."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, D = enc_states.shape
+    es = _pad_to(_pad_to(enc_states, 1, block_t), 2, _LANE)
+    ef = _pad_to(_pad_to(enc_feats, 1, block_t), 2, _LANE)
+    mask = _pad_to(enc_mask, 1, block_t)
+    cov = _pad_to(coverage, 1, block_t)
+    df = _pad_to(dec_feats, 1, _LANE)
+    vp = _pad_to(v[None, :], 1, _LANE)
+    wcp = _pad_to(w_c[None, :], 1, _LANE)
+    Tp, Dp = es.shape[1], es.shape[2]
+    nT = Tp // block_t
+
+    brow = lambda b, j: (b, 0)
+    tb3 = lambda b, j: (b, j, 0)
+    tb2 = lambda b, j: (b, j)
+    rep = lambda b, j: (0, 0)
+    ctx, p, mblk, stat = pl.pallas_call(
+        functools.partial(_blocked_kernel, use_coverage=use_coverage),
+        grid=(B, nT),
+        in_specs=[
+            pl.BlockSpec((1, block_t, Dp), tb3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_t, Dp), tb3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_t), tb2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Dp), brow, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_t), tb2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Dp), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Dp), rep, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, Dp), brow, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_t), tb2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), tb2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2), brow, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Dp), jnp.float32),
+            jax.ShapeDtypeStruct((B, Tp), jnp.float32),
+            jax.ShapeDtypeStruct((B, nT), jnp.float32),
+            jax.ShapeDtypeStruct((B, 2), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.float32),
+            pltpu.SMEM((1,), jnp.float32),
+            pltpu.VMEM((1, Dp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(es.astype(jnp.float32), ef.astype(jnp.float32),
+      mask.astype(jnp.float32), df.astype(jnp.float32),
+      cov.astype(jnp.float32), vp.astype(jnp.float32),
+      wcp.astype(jnp.float32))
+    m_fin = stat[:, 0:1]
+    l_fin = stat[:, 1:2]
+    corr = jnp.exp(jnp.repeat(mblk, block_t, axis=1) - m_fin)  # [B, Tp]
+    attn = p * corr / l_fin
+    return ctx[:, :D], attn[:, :T]
+
+
+# VMEM budget heuristic: two [T, D] f32 slices per row beyond this, stream
+# T in blocks instead (simple kernel holds both enc tensors at once).
+_SIMPLE_KERNEL_MAX_ELEMS = 1_000_000
+
+
 def _use_pallas() -> bool:
     env = os.environ.get("TS_PALLAS", "auto").lower()
     if env in ("0", "off", "false"):
@@ -157,6 +275,11 @@ def fused_attention(enc_states: Array, enc_feats: Array, enc_mask: Array,
     """(context [B, D], attn_dist [B, T]).  coverage is read only when
     use_coverage (pass zeros otherwise)."""
     if _use_pallas():
+        T, D = enc_states.shape[1], enc_states.shape[2]
+        if T * D > _SIMPLE_KERNEL_MAX_ELEMS:  # long-context: stream T
+            return _attention_pallas_blocked(enc_states, enc_feats, enc_mask,
+                                             dec_feats, coverage, v, w_c,
+                                             use_coverage)
         return _attention_pallas(enc_states, enc_feats, enc_mask, dec_feats,
                                  coverage, v, w_c, use_coverage)
     return _attention_xla(enc_states, enc_feats, enc_mask, dec_feats,

@@ -154,9 +154,32 @@ class Trainer:
 
     def train(self, num_steps: Optional[int] = None) -> TrainState:
         """Run until num_steps (hps.num_steps when None; 0 = until the
-        batcher is exhausted)."""
+        batcher is exhausted).
+
+        Profiling (SURVEY §5.1): the reference logs per-step wall clock
+        only; here TS_PROFILE_DIR=<dir> additionally captures a JAX/XLA
+        profiler trace of steps 2-7 (post-compilation) for TensorBoard's
+        trace viewer.
+        """
         limit = self.hps.num_steps if num_steps is None else num_steps
         last_ckpt = time.time()
+        profile_dir = os.environ.get("TS_PROFILE_DIR")
+        # anchor to the first step of THIS run (may resume past step 2)
+        profile_start = int(self.state.step) + 2
+        profile_stop = profile_start + 5
+        try:
+            return self._train_loop(limit, last_ckpt, profile_dir,
+                                    profile_start, profile_stop)
+        finally:
+            if profile_dir:
+                try:  # finalize a trace left open by an exception/NaN abort
+                    jax.profiler.stop_trace()
+                except RuntimeError:
+                    pass  # no trace active
+
+    def _train_loop(self, limit, last_ckpt, profile_dir, profile_start,
+                    profile_stop) -> TrainState:
+        profiling = False
         while True:
             step = int(self.state.step)
             if limit and step >= limit:
@@ -165,6 +188,10 @@ class Trainer:
             if batch is None:
                 log.info("batcher exhausted; stopping training at step %d", step)
                 break
+            if profile_dir and not profiling and step == profile_start:
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
+                log.info("profiler trace started -> %s", profile_dir)
             t0 = time.time()
             self.state, metrics = self._step_fn(self.state, batch.as_arrays())
             loss = float(metrics.loss)
@@ -182,10 +209,16 @@ class Trainer:
                 log.info("coverage_loss: %f", cl)
                 scalars["coverage_loss"] = cl
             self.writer.scalars(int(self.state.step), **scalars)
+            if profiling and step >= profile_stop:
+                jax.profiler.stop_trace()
+                profiling = False
+                log.info("profiler trace written to %s", profile_dir)
             if self.checkpointer is not None and \
                     time.time() - last_ckpt >= self.checkpoint_secs:
                 self.checkpointer.save(self.state)
                 last_ckpt = time.time()
+        if profiling:
+            jax.profiler.stop_trace()
         if self.checkpointer is not None:
             self.checkpointer.save(self.state)
         return self.state
