@@ -42,7 +42,8 @@ def main() -> None:
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     batch = int(os.environ.get("BENCH_BATCH", "16"))
 
-    hps = HParams(batch_size=batch, compute_dtype="bfloat16")
+    hps = HParams(batch_size=batch, compute_dtype="bfloat16",
+                  **_preset_overrides())
 
     state = trainer_lib.init_train_state(hps, hps.vocab_size, seed=0)
     step_fn = jax.jit(trainer_lib.make_train_step(hps), donate_argnums=0)
@@ -79,5 +80,59 @@ def main() -> None:
     }))
 
 
+def _preset_overrides() -> dict:
+    """BENCH_PRESET=tiny shrinks the model for smoke runs (full-scale
+    beam-search compiles take minutes on CPU); default is the reference
+    scale."""
+    if os.environ.get("BENCH_PRESET") == "tiny":
+        return dict(hidden_dim=16, emb_dim=8, vocab_size=200,
+                    max_enc_steps=32, max_dec_steps=8, beam_size=2,
+                    min_dec_steps=1, max_oov_buckets=8)
+    return {}
+
+
+def bench_decode() -> None:
+    """Secondary benchmark (BENCH_MODE=decode): batched beam-search decode
+    latency at the reference serving config (batch 4, enc 400, dec 100,
+    beam 4, TensorFlowTest.java:40-53).  The reference pays ~100 feed_dict
+    round trips per article (SURVEY §3.4); here a batch of articles is one
+    device dispatch."""
+    import jax
+
+    from textsummarization_on_flink_tpu.config import HParams
+    from textsummarization_on_flink_tpu.decode import beam_search
+    from textsummarization_on_flink_tpu.models import pointer_generator as pg
+    from __graft_entry__ import _example_arrays
+
+    iters = int(os.environ.get("BENCH_STEPS", "10"))
+    batch = int(os.environ.get("BENCH_BATCH", "4"))
+    hps = HParams(batch_size=batch, mode="decode", coverage=True,
+                  **_preset_overrides())
+    params = pg.init_params(hps, hps.vocab_size, jax.random.PRNGKey(0))
+    arrays = _example_arrays(hps, np.random.RandomState(0))
+    arrays = {k: v for k, v in arrays.items()
+              if not k.startswith(("dec_", "target_"))}
+    arrays = jax.device_put(arrays)
+
+    out = beam_search.run_beam_search_jit(params, hps, arrays)  # compile
+    jax.block_until_ready(out.tokens)
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = beam_search.run_beam_search_jit(params, hps, arrays)
+        jax.block_until_ready(out.tokens)
+        lat.append((time.perf_counter() - t0) / batch)
+    p50 = sorted(lat)[len(lat) // 2]
+    print(json.dumps({
+        "metric": "beam_decode_p50_latency_per_article",
+        "value": round(p50 * 1000, 2),
+        "unit": "ms",
+        "vs_baseline": 0.0,  # the reference publishes no decode latency
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_MODE", "train") == "decode":
+        bench_decode()
+    else:
+        main()

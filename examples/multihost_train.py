@@ -1,0 +1,50 @@
+"""Multi-host distributed training example.
+
+Replaces the reference's cluster recipe — ZooKeeper + TF1 PS/worker
+(SURVEY.md §2.6) — with the jax.distributed + SPMD mesh stack. Launch the
+SAME script on every host of a TPU pod slice:
+
+    # managed TPU environments auto-detect everything:
+    python examples/multihost_train.py --data_path=... --vocab_path=... \
+        --log_root=gs://bucket/log --exp_name=pod --dp=32 --batch_size=512
+
+    # manual bring-up (the reference's zookeeper_connect_str + worker
+    # index, HasClusterConfig.java:15-29) maps to:
+    COORD=10.0.0.2:8476 NPROC=4 PROC_ID=0 python examples/multihost_train.py ...
+
+The hps mesh axes (dp/tp/sp) span the GLOBAL device set: with 4 hosts x 8
+chips, --dp=32 data-shards the batch over every chip and XLA all-reduces
+gradients over ICI/DCN. Only the chief (process 0) writes checkpoints.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from textsummarization_on_flink_tpu import cli  # noqa: E402
+from textsummarization_on_flink_tpu.config import HParams  # noqa: E402
+from textsummarization_on_flink_tpu.data.vocab import Vocab  # noqa: E402
+from textsummarization_on_flink_tpu.parallel import distributed  # noqa: E402
+
+
+def main(argv):
+    distributed.initialize(
+        coordinator_address=os.environ.get("COORD"),
+        num_processes=(int(os.environ["NPROC"])
+                       if "NPROC" in os.environ else None),
+        process_id=(int(os.environ["PROC_ID"])
+                    if "PROC_ID" in os.environ else None))
+    hps = HParams.from_argv(argv).replace(mode="train")
+    hps.validate()
+    vocab = Vocab(hps.vocab_path, hps.vocab_size)
+    # every host runs the same SPMD program; Trainer builds the global
+    # (dp, tp, sp) mesh from hps and pjits the step over it
+    state = cli.setup_training(hps, vocab)
+    if distributed.is_chief():
+        print(f"trained to step {int(state.step)}")
+    distributed.barrier("train-done")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

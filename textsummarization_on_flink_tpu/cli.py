@@ -48,11 +48,17 @@ def setup_training(hps: HParams, vocab: Vocab,
                    batcher: Optional[Batcher] = None) -> trainer_lib.TrainState:
     """run_summarization.py:181-209: restore-or-init, train with periodic
     checkpoints (save_model_secs=60 parity)."""
+    from textsummarization_on_flink_tpu.parallel import distributed
+
     _, train_dir, _ = _dirs(hps)
     batcher = batcher or Batcher(hps.data_path, vocab, hps,
                                  single_pass=hps.single_pass)
-    checkpointer = ckpt_lib.Checkpointer(train_dir, hps=hps)
-    state = checkpointer.restore()
+    # multi-host: only the chief writes checkpoints (the reference's
+    # is_chief MonitoredTrainingSession role, train.py:74-81); every host
+    # still RESTORES so a resumed run starts from the same step.
+    reader = ckpt_lib.Checkpointer(train_dir, hps=hps)
+    checkpointer = reader if distributed.is_chief() else None
+    state = reader.restore()
     if state is not None:
         log.info("restored training from step %d", int(state.step))
     trainer = trainer_lib.Trainer(hps, vocab.size(), batcher, state=state,

@@ -17,6 +17,7 @@ Summaries are JSON-lines under `<log_root>/<exp_name>/<job>/events.jsonl`
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 import os
@@ -106,21 +107,30 @@ def calc_running_avg_loss(loss: float, running_avg_loss: float,
 class SummaryWriter:
     """JSONL scalar summaries (TensorBoard-writer stand-in), flushed
     immediately — the reference flushes every 100 steps
-    (run_summarization.py:242-244)."""
+    (run_summarization.py:242-244).  Multi-host: only the chief writes
+    (is_chief MonitoredTrainingSession role, train.py:74-81); other hosts
+    get a no-op writer so a shared log_root sees one record per step."""
 
     def __init__(self, directory: str):
-        os.makedirs(directory, exist_ok=True)
-        self._path = os.path.join(directory, "events.jsonl")
-        self._f = open(self._path, "a", encoding="utf-8")
+        from textsummarization_on_flink_tpu.parallel import distributed
+
+        self._f = None
+        if distributed.is_chief():
+            os.makedirs(directory, exist_ok=True)
+            self._path = os.path.join(directory, "events.jsonl")
+            self._f = open(self._path, "a", encoding="utf-8")
 
     def scalars(self, step: int, **values: float) -> None:
+        if self._f is None:
+            return
         rec = {"step": int(step)}
         rec.update({k: float(v) for k, v in values.items()})
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
 
     def close(self) -> None:
-        self._f.close()
+        if self._f is not None:
+            self._f.close()
 
 
 class NonFiniteLossError(RuntimeError):
@@ -150,7 +160,35 @@ class Trainer:
         self.train_dir = train_dir or os.path.join(
             hps.log_root or ".", hps.exp_name or "exp", "train")
         self.writer = SummaryWriter(self.train_dir)
-        self._step_fn = step_fn or jax.jit(make_train_step(hps), donate_argnums=0)
+        self._shard_batch: Optional[Callable] = None
+        if step_fn is None:
+            if hps.dp * hps.tp * hps.sp > 1:
+                # SPMD over the (dp, tp, sp) mesh: the sharded step IS the
+                # distributed backend (parallel/mesh.py) — XLA inserts the
+                # dp-axis gradient psum and tp/sp collectives.
+                from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
+
+                vsize_actual = np.asarray(
+                    self.state.params["embedding"]).shape[0]
+                if hps.tp > 1 and vsize_actual % hps.tp != 0:
+                    raise ValueError(
+                        f"tensor-parallel axis tp={hps.tp} must divide the "
+                        f"actual vocabulary size {vsize_actual} (the vocab "
+                        f"file may hold fewer words than --vocab_size); "
+                        f"pick a dividing tp or trim the vocab")
+                if hps.dp > 1 and hps.batch_size % hps.dp != 0:
+                    raise ValueError(
+                        f"data-parallel axis dp={hps.dp} must divide "
+                        f"batch_size={hps.batch_size}")
+                plan = mesh_lib.make_mesh(hps)
+                self.state = mesh_lib.shard_train_state(plan, self.state)
+                self._shard_batch = functools.partial(
+                    mesh_lib.shard_batch, plan)
+                step_fn = mesh_lib.make_sharded_train_step(
+                    plan, state=self.state)
+            else:
+                step_fn = jax.jit(make_train_step(hps), donate_argnums=0)
+        self._step_fn = step_fn
 
     def train(self, num_steps: Optional[int] = None) -> TrainState:
         """Run until num_steps (hps.num_steps when None; 0 = until the
@@ -193,7 +231,10 @@ class Trainer:
                 profiling = True
                 log.info("profiler trace started -> %s", profile_dir)
             t0 = time.time()
-            self.state, metrics = self._step_fn(self.state, batch.as_arrays())
+            arrays = batch.as_arrays()
+            if self._shard_batch is not None:
+                arrays = self._shard_batch(arrays)
+            self.state, metrics = self._step_fn(self.state, arrays)
             loss = float(metrics.loss)
             t1 = time.time()
             log.info("seconds for training step: %.3f", t1 - t0)
@@ -239,7 +280,15 @@ class Evaluator:
         self.best_saver = best_saver
         self.running_avg_loss = 0.0
         self.best_loss: Optional[float] = None
-        self._eval_fn = jax.jit(make_eval_step(hps))
+        self._shard_batch: Optional[Callable] = None
+        if hps.dp * hps.tp * hps.sp > 1:  # same auto-mesh rule as Trainer
+            from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
+
+            plan = mesh_lib.make_mesh(hps)
+            self._shard_batch = functools.partial(mesh_lib.shard_batch, plan)
+            self._eval_fn = mesh_lib.make_sharded_eval_step(plan)
+        else:
+            self._eval_fn = jax.jit(make_eval_step(hps))
 
     def run(self, params: PyTree, step: int, max_batches: int = 0) -> float:
         """Evaluate batches (all, or max_batches); returns running avg loss."""
@@ -249,7 +298,10 @@ class Evaluator:
             if batch is None:
                 break
             t0 = time.time()
-            metrics = self._eval_fn(params, batch.as_arrays())
+            arrays = batch.as_arrays()
+            if self._shard_batch is not None:
+                arrays = self._shard_batch(arrays)
+            metrics = self._eval_fn(params, arrays)
             loss = float(metrics.total_loss if self.hps.coverage else metrics.loss)
             log.info("seconds for eval batch: %.3f  loss: %f", time.time() - t0, loss)
             if not np.isfinite(loss):
