@@ -1,0 +1,3 @@
+#!/bin/bash
+# Standalone raw-text inference launcher (reference run_inference.sh parity).
+python -m textsummarization_on_flink_tpu --mode=decode --inference=1 --coverage=1 "$@"

@@ -1,0 +1,4 @@
+#!/bin/bash
+# Standalone training launcher (reference run_train.sh parity:
+# /root/reference/src/main/python/pointer-generator/run_train.sh).
+python -m textsummarization_on_flink_tpu --mode=train --coverage=1 "$@"

@@ -1,0 +1,28 @@
+"""Deprecated raw driver (Summarization.java parity): direct train+infer."""
+
+import pytest
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.pipeline import raw_driver
+from textsummarization_on_flink_tpu.pipeline.io import CollectionSource
+
+WORDS = ("article reference the quick brown fox jumped over lazy dog "
+         "0 1 2 3 4 5 6 7").split()
+
+
+def test_raw_training_then_inference(tmp_path):
+    vocab = Vocab(words=WORDS)
+    rows = [(f"uuid-{i}", f"article {i} .", "", f"reference {i} .")
+            for i in range(8)]
+    hps = HParams(mode="train", num_steps=1, batch_size=4, hidden_dim=8,
+                  emb_dim=6, vocab_size=24, max_enc_steps=12, max_dec_steps=6,
+                  beam_size=2, min_dec_steps=1, max_oov_buckets=4,
+                  log_root=str(tmp_path), exp_name="raw")
+    with pytest.warns(DeprecationWarning):
+        state = raw_driver.training(hps, CollectionSource(rows), vocab=vocab)
+    assert int(state.step) == 1
+    with pytest.warns(DeprecationWarning):
+        sink = raw_driver.inference(hps, CollectionSource(rows[:3]),
+                                    vocab=vocab)
+    assert len(sink.rows) == 3

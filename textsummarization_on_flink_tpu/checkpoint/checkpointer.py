@@ -83,6 +83,13 @@ def _unflatten_dicts(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
 
 
 def state_to_arrays(state: TrainState) -> Dict[str, np.ndarray]:
+    if jax.process_count() > 1:
+        # tp/sp shards may live on other hosts' devices; a bare device_get
+        # raises on non-addressable arrays. All-gather the full values
+        # first (every host participates; only the chief writes).
+        from jax.experimental import multihost_utils
+
+        state = multihost_utils.process_allgather(state, tiled=True)
     return _flatten(state)
 
 
@@ -195,9 +202,17 @@ class Checkpointer:
             os.replace(tmp, os.path.join(directory, "hparams.json"))
 
     def save(self, state: TrainState) -> str:
-        step = int(np.asarray(jax.device_get(state.step)))
+        """Multi-host: EVERY host must call this (the shard gather inside
+        state_to_arrays is collective); only the chief touches the
+        filesystem."""
+        from textsummarization_on_flink_tpu.parallel import distributed
+
+        flat = state_to_arrays(state)  # collective on multi-host
+        step = int(np.asarray(flat.get("step", 0)))
         path = os.path.join(self.directory, f"{CKPT_PREFIX}-{step}.npz")
-        save_arrays(path, state_to_arrays(state))
+        if not distributed.is_chief():
+            return path
+        save_arrays(path, flat)
         _write_index(self.directory, path, INDEX_FILE)
         self._retain()
         log.info("saved checkpoint %s", path)

@@ -53,12 +53,15 @@ def setup_training(hps: HParams, vocab: Vocab,
     _, train_dir, _ = _dirs(hps)
     batcher = batcher or Batcher(hps.data_path, vocab, hps,
                                  single_pass=hps.single_pass)
-    # multi-host: only the chief writes checkpoints (the reference's
-    # is_chief MonitoredTrainingSession role, train.py:74-81); every host
-    # still RESTORES so a resumed run starts from the same step.
-    reader = ckpt_lib.Checkpointer(train_dir, hps=hps)
-    checkpointer = reader if distributed.is_chief() else None
-    state = reader.restore()
+    # Checkpointer.save is collective-then-chief-writes, so every host
+    # holds one (the reference's is_chief MonitoredTrainingSession role,
+    # train.py:74-81, applies to the WRITE inside save); every host also
+    # restores so a resumed run starts from the same step.
+    checkpointer = ckpt_lib.Checkpointer(train_dir, hps=hps)
+    if distributed.is_chief():
+        # embedding-projector metadata (model.py:185-197, data.py:93-105)
+        vocab.write_metadata(os.path.join(train_dir, "vocab_metadata.tsv"))
+    state = checkpointer.restore()
     if state is not None:
         log.info("restored training from step %d", int(state.step))
     trainer = trainer_lib.Trainer(hps, vocab.size(), batcher, state=state,
@@ -106,8 +109,14 @@ def run_decode(hps: HParams, vocab: Vocab,
                               example_source=raw_text_example_source(
                                   hps.data_path))
         else:
+            # The reference repeats ONE article across the batch because
+            # its beam occupies the batch axis (run_summarization.py:312,
+            # batcher.py:344-347).  Our beam search carries its own beam
+            # axis, so a decode batch holds batch_size DISTINCT articles —
+            # same per-article results, batch_size x the throughput.
             batcher = Batcher(hps.data_path, vocab, decode_hps,
-                              single_pass=hps.single_pass)
+                              single_pass=hps.single_pass,
+                              decode_batch_mode="distinct")
     _, train_dir, _ = _dirs(hps)
     decoder = BeamSearchDecoder(decode_hps, vocab, batcher,
                                 train_dir=train_dir)

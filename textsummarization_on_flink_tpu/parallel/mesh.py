@@ -203,13 +203,17 @@ def make_sharded_train_step(plan: MeshPlan, donate: bool = True,
     )
 
 
-def make_sharded_eval_step(plan: MeshPlan):
+def make_sharded_eval_step(plan: MeshPlan, params: Optional[PyTree] = None):
+    """Pass `params` when the tree structure differs from a fresh init
+    (e.g. a TF1-imported checkpoint) so in_shardings match, mirroring
+    make_sharded_train_step's `state` parameter."""
     hps = plan.hps
     eval_fn = trainer_lib.make_eval_step(hps)
-    probe = jax.eval_shape(
-        lambda: trainer_lib.init_train_state(hps, hps.vocab_size, seed=0))
+    probe = params if params is not None else jax.eval_shape(
+        lambda: trainer_lib.init_train_state(hps, hps.vocab_size,
+                                             seed=0)).params
     param_sh = jax.tree_util.tree_map(
-        lambda s: plan.named(s), param_pspecs(probe.params),
+        lambda s: plan.named(s), param_pspecs(probe),
         is_leaf=lambda x: isinstance(x, P))
     del probe
     batch_sh = batch_sharding(plan)
@@ -218,3 +222,38 @@ def make_sharded_eval_step(plan: MeshPlan):
         total_loss=plan.named(P()), global_norm=plan.named(P()))
     return jax.jit(eval_fn, in_shardings=(param_sh, batch_sh),
                    out_shardings=metric_sh)
+
+
+def validate_divisibility(hps: HParams, params: Optional[PyTree] = None,
+                          ) -> None:
+    """Fail fast with actionable errors instead of opaque device_put
+    shape complaints (the vocab file may hold fewer words than
+    --vocab_size, so the ACTUAL embedding rows are what tp must divide)."""
+    if hps.dp > 1 and hps.batch_size % hps.dp != 0:
+        raise ValueError(f"data-parallel axis dp={hps.dp} must divide "
+                         f"batch_size={hps.batch_size}")
+    if hps.tp > 1 and params is not None:
+        vsize_actual = params["embedding"].shape[0]
+        if vsize_actual % hps.tp != 0:
+            raise ValueError(
+                f"tensor-parallel axis tp={hps.tp} must divide the actual "
+                f"vocabulary size {vsize_actual} (the vocab file may hold "
+                f"fewer words than --vocab_size); pick a dividing tp or "
+                f"trim the vocab")
+    if hps.sp > 1 and hps.max_enc_steps % hps.sp != 0:
+        raise ValueError(f"sequence-parallel axis sp={hps.sp} must divide "
+                         f"max_enc_steps={hps.max_enc_steps}")
+
+
+def global_batch_from_host_local(plan: MeshPlan,
+                                 arrays: Dict[str, Any]) -> Dict[str, Any]:
+    """Multi-host batch assembly: each process contributes ITS OWN rows
+    (batch_size/process_count of them) and the result is the global
+    dp-sharded batch — per-host batchers legitimately hold different data
+    (that IS data parallelism), so a plain device_put of per-host copies
+    would silently interleave unrelated rows."""
+    from jax.experimental import multihost_utils
+
+    pspecs = {k: batch_pspec(k) for k in arrays}
+    return multihost_utils.host_local_array_to_global_array(
+        arrays, plan.mesh, pspecs)

@@ -86,6 +86,20 @@ def reference_to_abstract(reference: str) -> str:
                     for s in sent_tokenize(reference))
 
 
+def rows_to_examples(rows: Iterator[Row]) -> Iterator[Tuple[str, str, str, str]]:
+    """(uuid, article, reference) rows -> batcher 4-tuples (the one
+    adapter between the streaming row schema and SummaryExample)."""
+    for row in rows:
+        uuid, article, reference = str(row[0]), str(row[1]), str(row[2])
+        yield uuid, article, reference_to_abstract(reference), reference
+
+
+def train_dir_for(hps: HParams) -> str:
+    """`<log_root>/<exp_name>/train` — the weights hand-off directory
+    (train.py:64; SURVEY §3.1 'Important semantics')."""
+    return os.path.join(hps.log_root or ".", hps.exp_name or "exp", "train")
+
+
 class PipelineStage(P.WithParams):
     """Base with params-JSON persistence (PipelineStage.toJson parity)."""
 
@@ -222,17 +236,13 @@ class SummarizationModel(Model,
         feeder = _BridgeFeeder(source, sel, coding, q).start()
 
         def example_source():
-            for row in _rows_from_queue(q, coding):
-                uuid, article, reference = (str(row[0]), str(row[1]),
-                                            str(row[2]))
-                # inference has no gold abstract; reference text rides along
-                yield uuid, article, reference_to_abstract(reference), reference
+            # inference has no gold abstract; reference text rides along
+            return rows_to_examples(_rows_from_queue(q, coding))
 
         batcher = Batcher("", vocab, hps, single_pass=True,
                           decode_batch_mode="distinct",
                           example_source=example_source)
-        train_dir = os.path.join(hps.log_root or ".", hps.exp_name or "exp",
-                                 "train")
+        train_dir = train_dir_for(hps)
         decoder = BeamSearchDecoder(
             hps.replace(single_pass=False), vocab, batcher,
             train_dir=train_dir,
@@ -288,15 +298,11 @@ class SummarizationEstimator(Estimator,
         feeder = _BridgeFeeder(source, sel, coding, q).start()
 
         def example_source():
-            for row in _rows_from_queue(q, coding):
-                uuid, article, reference = (str(row[0]), str(row[1]),
-                                            str(row[2]))
-                yield uuid, article, reference_to_abstract(reference), reference
+            return rows_to_examples(_rows_from_queue(q, coding))
 
         batcher = Batcher("", vocab, hps, single_pass=True,
                           example_source=example_source)
-        train_dir = os.path.join(hps.log_root or ".", hps.exp_name or "exp",
-                                 "train")
+        train_dir = train_dir_for(hps)
         checkpointer = ckpt_lib.Checkpointer(train_dir, hps=hps)
         prev = checkpointer.restore()
         state = None

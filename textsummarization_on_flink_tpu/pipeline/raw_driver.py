@@ -1,0 +1,90 @@
+"""DEPRECATED pre-pipeline raw driver (me/littlebo/Summarization.java parity).
+
+The reference keeps an @Deprecated driver that calls TFUtils.train /
+TFUtils.inference directly with a hand-built TFConfig, bypassing the
+Estimator/Model param system (Summarization.java:28,79-155).  This module
+is its equivalent: direct training()/inference() calls wiring sources to
+the trainer/decoder with explicit HParams — kept for surface parity and as
+the minimal example of driving the engine without the pipeline layer.
+Prefer pipeline.estimator / pipeline.app.
+
+Deprecated mirror of the reference; not used by anything else in-tree.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import warnings
+from typing import Optional
+
+from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.batcher import Batcher
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.decode.decoder import BeamSearchDecoder
+from textsummarization_on_flink_tpu.pipeline.estimator import (
+    rows_to_examples,
+    train_dir_for,
+)
+from textsummarization_on_flink_tpu.pipeline.io import (
+    CollectionSink,
+    Sink,
+    Source,
+)
+from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+
+log = logging.getLogger(__name__)
+
+
+def _deprecated() -> None:
+    warnings.warn(
+        "pipeline.raw_driver mirrors the reference's @Deprecated "
+        "Summarization driver; use pipeline.estimator / pipeline.app",
+        DeprecationWarning, stacklevel=3)
+
+
+def training(hps: HParams, source: Source,
+             vocab: Optional[Vocab] = None) -> trainer_lib.TrainState:
+    """Summarization.training() parity (:79-118): train directly from a
+    row stream, no param system."""
+    _deprecated()
+    vocab = vocab or Vocab(hps.vocab_path, hps.vocab_size)
+
+    def example_source():
+        return rows_to_examples(
+            (r[0], r[1], r[3]) for r in source.rows())
+
+    batcher = Batcher("", vocab, hps.replace(mode="train"), single_pass=True,
+                      example_source=example_source)
+    train_dir = train_dir_for(hps)
+    trainer = trainer_lib.Trainer(hps, vocab.size(), batcher,
+                                  checkpointer=ckpt_lib.Checkpointer(
+                                      train_dir, hps=hps),
+                                  train_dir=train_dir)
+    return trainer.train(num_steps=hps.num_steps)
+
+
+def inference(hps: HParams, source: Source, sink: Optional[Sink] = None,
+              vocab: Optional[Vocab] = None) -> Sink:
+    """Summarization.inference() parity (:120-155)."""
+    _deprecated()
+    vocab = vocab or Vocab(hps.vocab_path, hps.vocab_size)
+    out = sink if sink is not None else CollectionSink()
+
+    def example_source():
+        return rows_to_examples(
+            (r[0], r[1], r[3]) for r in source.rows())
+
+    dec_hps = hps.replace(mode="decode", single_pass=False)
+    batcher = Batcher("", vocab, dec_hps, single_pass=True,
+                      decode_batch_mode="distinct",
+                      example_source=example_source)
+    train_dir = train_dir_for(hps)
+    decoder = BeamSearchDecoder(dec_hps, vocab, batcher, train_dir=train_dir,
+                                decode_root=os.path.join(
+                                    hps.log_root or ".",
+                                    hps.exp_name or "exp"))
+    decoder.decode(result_sink=lambda r: out.write(r.as_row()),
+                   log_results=False)
+    return out

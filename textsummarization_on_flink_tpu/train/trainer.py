@@ -168,22 +168,17 @@ class Trainer:
                 # dp-axis gradient psum and tp/sp collectives.
                 from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
 
-                vsize_actual = np.asarray(
-                    self.state.params["embedding"]).shape[0]
-                if hps.tp > 1 and vsize_actual % hps.tp != 0:
-                    raise ValueError(
-                        f"tensor-parallel axis tp={hps.tp} must divide the "
-                        f"actual vocabulary size {vsize_actual} (the vocab "
-                        f"file may hold fewer words than --vocab_size); "
-                        f"pick a dividing tp or trim the vocab")
-                if hps.dp > 1 and hps.batch_size % hps.dp != 0:
-                    raise ValueError(
-                        f"data-parallel axis dp={hps.dp} must divide "
-                        f"batch_size={hps.batch_size}")
+                mesh_lib.validate_divisibility(hps, self.state.params)
                 plan = mesh_lib.make_mesh(hps)
                 self.state = mesh_lib.shard_train_state(plan, self.state)
-                self._shard_batch = functools.partial(
-                    mesh_lib.shard_batch, plan)
+                if jax.process_count() > 1:
+                    # each host's batcher feeds ITS shard of the global
+                    # batch (batch_size/process_count rows per host)
+                    self._shard_batch = functools.partial(
+                        mesh_lib.global_batch_from_host_local, plan)
+                else:
+                    self._shard_batch = functools.partial(
+                        mesh_lib.shard_batch, plan)
                 step_fn = mesh_lib.make_sharded_train_step(
                     plan, state=self.state)
             else:
@@ -281,12 +276,14 @@ class Evaluator:
         self.running_avg_loss = 0.0
         self.best_loss: Optional[float] = None
         self._shard_batch: Optional[Callable] = None
+        self._mesh_plan = None
         if hps.dp * hps.tp * hps.sp > 1:  # same auto-mesh rule as Trainer
             from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
 
-            plan = mesh_lib.make_mesh(hps)
-            self._shard_batch = functools.partial(mesh_lib.shard_batch, plan)
-            self._eval_fn = mesh_lib.make_sharded_eval_step(plan)
+            self._mesh_plan = mesh_lib.make_mesh(hps)
+            self._shard_batch = functools.partial(
+                mesh_lib.shard_batch, self._mesh_plan)
+            self._eval_fn = None  # built lazily per params structure
         else:
             self._eval_fn = jax.jit(make_eval_step(hps))
 
@@ -301,6 +298,14 @@ class Evaluator:
             arrays = batch.as_arrays()
             if self._shard_batch is not None:
                 arrays = self._shard_batch(arrays)
+            if self._eval_fn is None:  # mesh path: build for THIS params
+                from textsummarization_on_flink_tpu.parallel import (
+                    mesh as mesh_lib,
+                )
+
+                mesh_lib.validate_divisibility(self.hps, params)
+                self._eval_fn = mesh_lib.make_sharded_eval_step(
+                    self._mesh_plan, params=params)
             metrics = self._eval_fn(params, arrays)
             loss = float(metrics.total_loss if self.hps.coverage else metrics.loss)
             log.info("seconds for eval batch: %.3f  loss: %f", time.time() - t0, loss)

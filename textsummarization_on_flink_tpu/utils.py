@@ -1,0 +1,10 @@
+"""Small host-side utilities (me/littlebo/SysUtils.java parity)."""
+
+from __future__ import annotations
+
+import os
+
+
+def get_project_root_dir() -> str:
+    """The process working directory (SysUtils.java:4-6 `user.dir`)."""
+    return os.getcwd()
