@@ -140,3 +140,20 @@ def test_decode_batch_emits_valid_words(tmp_path, vocab, train_dir):
         for w in r.decoded_words:
             assert isinstance(w, str) and w  # real words, never raw ids
             assert w != "[STOP]"
+
+
+def test_decoder_multichip_dp(tmp_path, vocab, train_dir):
+    """BeamSearchDecoder with dp>1 serves through the sharded search."""
+    hps = HPS.replace(single_pass=False, dp=4, batch_size=4)
+    batcher = Batcher("", vocab, hps, single_pass=True,
+                      decode_batch_mode="distinct",
+                      example_source=make_source(4))
+    d = dec_lib.BeamSearchDecoder(hps, vocab, batcher, train_dir=train_dir,
+                                  decode_root=str(tmp_path),
+                                  max_ckpt_retries=0)
+    assert d._sharded_search is not None
+    rows = []
+    d.decode(result_sink=lambda r: rows.append(r.as_row()), log_results=False)
+    assert len(rows) == 4
+    for uuid, art, summary, ref in rows:
+        assert isinstance(summary, str)

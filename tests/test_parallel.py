@@ -114,3 +114,28 @@ def test_multi_step_training_loss_decreases(setup):
         losses.append(float(metrics.loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_sharded_beam_search_matches_single_device(setup):
+    """dp-sharded decode returns the same hypotheses as single-device."""
+    from textsummarization_on_flink_tpu.decode import beam_search
+    from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
+
+    hps, vocab, batch, state, _, _ = setup
+    dec_hps = hps.replace(mode="decode", dp=4, tp=1, sp=1, beam_size=2,
+                          min_dec_steps=1)
+    enc_only = {k: v for k, v in batch.as_arrays().items()
+                if k.startswith("enc_")}
+    single = beam_search.run_beam_search(state.params,
+                                         dec_hps.replace(dp=1), enc_only)
+    plan = mesh_lib.make_mesh(dec_hps)
+    fn = mesh_lib.make_sharded_beam_search(plan)
+    sharded_params = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, plan.named(s)), state.params,
+        mesh_lib.param_pspecs(state.params),
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+    out = fn(sharded_params, mesh_lib.shard_batch(plan, enc_only))
+    np.testing.assert_array_equal(np.asarray(out.tokens), single.tokens)
+    np.testing.assert_array_equal(np.asarray(out.length), single.length)
+    np.testing.assert_allclose(np.asarray(out.avg_log_prob),
+                               single.avg_log_prob, rtol=1e-5)

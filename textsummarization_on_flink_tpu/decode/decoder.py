@@ -130,6 +130,17 @@ class BeamSearchDecoder:
         if params is None:
             self._load_params()
 
+        self._sharded_search = None
+        self._mesh_plan = None
+        if hps.dp * hps.tp * hps.sp > 1:
+            # multi-chip serving: articles shard over dp, beams chip-local
+            from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
+
+            mesh_lib.validate_divisibility(hps, self._params)
+            self._mesh_plan = mesh_lib.make_mesh(hps)
+            self._sharded_search = mesh_lib.make_sharded_beam_search(
+                self._mesh_plan, params=self._params)
+
         root = decode_root or os.path.join(hps.log_root or ".",
                                            hps.exp_name or "exp")
         if hps.single_pass:
@@ -175,8 +186,18 @@ class BeamSearchDecoder:
         """One device dispatch for the whole batch; returns one result per
         DISTINCT article (decode-mode batches may repeat one article
         beam_size times, batcher.py:344-347 — repeats are collapsed)."""
-        out = beam_search.run_beam_search(self._params, self._hps,
-                                          batch.as_arrays())
+        if self._sharded_search is not None:
+            from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
+
+            enc_only = {k: v for k, v in batch.as_arrays().items()
+                        if k.startswith("enc_")}
+            raw = self._sharded_search(
+                self._params, mesh_lib.shard_batch(self._mesh_plan, enc_only))
+            out = beam_search.BeamSearchOutput(
+                *[np.asarray(x) for x in raw])
+        else:
+            out = beam_search.run_beam_search(self._params, self._hps,
+                                              batch.as_arrays())
         results: List[DecodedResult] = []
         seen: set = set()
         for b in range(len(batch.original_articles)):

@@ -167,6 +167,17 @@ def shard_batch(plan: MeshPlan, arrays: Dict[str, Any]) -> Dict[str, Any]:
 # Sharded step functions
 # --------------------------------------------------------------------------
 
+def param_shardings(plan: MeshPlan, params: Optional[PyTree] = None):
+    """NamedSharding tree for a parameter pytree; pass `params` when its
+    structure differs from a fresh init (e.g. TF1-imported trees)."""
+    probe = params if params is not None else jax.eval_shape(
+        lambda: trainer_lib.init_train_state(
+            plan.hps, plan.hps.vocab_size, seed=0)).params
+    return jax.tree_util.tree_map(
+        lambda s: plan.named(s), param_pspecs(probe),
+        is_leaf=lambda x: isinstance(x, P))
+
+
 def make_sharded_train_step(plan: MeshPlan, donate: bool = True,
                             state: Optional[trainer_lib.TrainState] = None):
     """pjit the train step over the mesh.
@@ -209,13 +220,7 @@ def make_sharded_eval_step(plan: MeshPlan, params: Optional[PyTree] = None):
     make_sharded_train_step's `state` parameter."""
     hps = plan.hps
     eval_fn = trainer_lib.make_eval_step(hps)
-    probe = params if params is not None else jax.eval_shape(
-        lambda: trainer_lib.init_train_state(hps, hps.vocab_size,
-                                             seed=0)).params
-    param_sh = jax.tree_util.tree_map(
-        lambda s: plan.named(s), param_pspecs(probe),
-        is_leaf=lambda x: isinstance(x, P))
-    del probe
+    param_sh = param_shardings(plan, params)
     batch_sh = batch_sharding(plan)
     metric_sh = trainer_lib.StepMetrics(
         loss=plan.named(P()), coverage_loss=plan.named(P()),
@@ -243,6 +248,36 @@ def validate_divisibility(hps: HParams, params: Optional[PyTree] = None,
     if hps.sp > 1 and hps.max_enc_steps % hps.sp != 0:
         raise ValueError(f"sequence-parallel axis sp={hps.sp} must divide "
                          f"max_enc_steps={hps.max_enc_steps}")
+
+
+def make_sharded_beam_search(plan: MeshPlan,
+                             params: Optional[PyTree] = None):
+    """Multi-chip serving: beam-search decode with the article batch
+    sharded over dp (each chip searches its own articles; beams stay
+    chip-local, so there is zero cross-chip traffic during the decode
+    loop — the ideal layout for throughput serving).
+
+    Returns a jitted fn(params, arrays) -> BeamSearchOutput.  Encoder
+    inputs shard over (dp[, sp]); params replicate/tp-shard as in
+    training.
+    """
+    from textsummarization_on_flink_tpu.decode import beam_search
+
+    hps = plan.hps
+    param_sh = param_shardings(plan, params)
+    enc_names = ("enc_batch", "enc_lens", "enc_padding_mask",
+                 "enc_batch_extend_vocab")
+    batch_sh = {k: plan.named(batch_pspec(k)) for k in enc_names}
+    out_sh = beam_search.BeamSearchOutput(
+        tokens=plan.named(P("dp")), length=plan.named(P("dp")),
+        avg_log_prob=plan.named(P("dp")), attn_dists=plan.named(P("dp")),
+        p_gens=plan.named(P("dp")))
+
+    def search(p, arrays):
+        return beam_search._search_batch(p, hps, arrays)
+
+    return jax.jit(search, in_shardings=(param_sh, batch_sh),
+                   out_shardings=out_sh)
 
 
 def global_batch_from_host_local(plan: MeshPlan,

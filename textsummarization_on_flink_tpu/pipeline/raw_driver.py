@@ -52,8 +52,10 @@ def training(hps: HParams, source: Source,
     vocab = vocab or Vocab(hps.vocab_path, hps.vocab_size)
 
     def example_source():
+        # accept 3-col (uuid, article, reference) or 4-col rows with the
+        # summary column in between — reference is always the LAST column
         return rows_to_examples(
-            (r[0], r[1], r[3]) for r in source.rows())
+            (r[0], r[1], r[-1]) for r in source.rows())
 
     batcher = Batcher("", vocab, hps.replace(mode="train"), single_pass=True,
                       example_source=example_source)
@@ -74,7 +76,7 @@ def inference(hps: HParams, source: Source, sink: Optional[Sink] = None,
 
     def example_source():
         return rows_to_examples(
-            (r[0], r[1], r[3]) for r in source.rows())
+            (r[0], r[1], r[-1]) for r in source.rows())
 
     dec_hps = hps.replace(mode="decode", single_pass=False)
     batcher = Batcher("", vocab, dec_hps, single_pass=True,

@@ -137,6 +137,71 @@ class NonFiniteLossError(RuntimeError):
     """Raised by the NaN/Inf watchdog (train.py:107-108 parity)."""
 
 
+class DevicePrefetcher:
+    """Double-buffered host->device feed (SURVEY §2.5 'intra-op
+    threading' row: the reference keeps the feed queue full with 16+4
+    batcher threads; on TPU the remaining stall is the synchronous H2D
+    copy, hidden here by transferring batch N+1 while N computes).
+
+    Wraps any batcher; `next_batch()` returns (batch, device_arrays).
+    """
+
+    def __init__(self, batcher: Any, transfer: Callable[[Dict], Dict],
+                 depth: int = 2):
+        import queue as queue_lib
+        import threading
+
+        self._batcher = batcher
+        self._transfer = transfer
+        self._q: Any = queue_lib.Queue(maxsize=max(depth, 1))
+        self._done = object()
+        self._stopped = threading.Event()
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        import queue as queue_lib
+
+        while not self._stopped.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue_lib.Full:
+                continue
+        return False
+
+    def _pump(self) -> None:
+        try:
+            while not self._stopped.is_set():
+                batch = self._batcher.next_batch()
+                if batch is None:
+                    break
+                # the device_put happens HERE, ahead of the consumer
+                if not self._put((batch, self._transfer(batch.as_arrays()))):
+                    return  # stopped while parked on a full queue
+        except BaseException as e:  # re-raised by the consumer
+            self.error = e
+            log.exception("device prefetcher failed")
+        finally:
+            self._put(self._done)
+
+    def next_batch(self):
+        item = self._q.get()
+        if item is self._done:
+            if self.error is not None:
+                raise RuntimeError(
+                    "input pipeline failed mid-training") from self.error
+            return None
+        return item
+
+    def stop(self) -> None:
+        """Reap the pump thread (a limit/abort exit must not keep draining
+        the shared source)."""
+        self._stopped.set()
+        self._thread.join(timeout=10.0)
+
+
 class Trainer:
     """Single-host training driver.
 
@@ -171,11 +236,30 @@ class Trainer:
                 mesh_lib.validate_divisibility(hps, self.state.params)
                 plan = mesh_lib.make_mesh(hps)
                 self.state = mesh_lib.shard_train_state(plan, self.state)
-                if jax.process_count() > 1:
-                    # each host's batcher feeds ITS shard of the global
-                    # batch (batch_size/process_count rows per host)
-                    self._shard_batch = functools.partial(
-                        mesh_lib.global_batch_from_host_local, plan)
+                nproc = jax.process_count()
+                if nproc > 1:
+                    # Each host's batcher must feed ITS shard of the
+                    # global batch: batch_size/process_count rows per
+                    # host (configure the batcher with the LOCAL size;
+                    # hps.batch_size stays the global batch).
+                    if hps.batch_size % nproc != 0:
+                        raise ValueError(
+                            f"batch_size={hps.batch_size} must be "
+                            f"divisible by process_count={nproc}")
+                    local_rows = hps.batch_size // nproc
+
+                    def to_global(arrays, _local=local_rows, _plan=plan):
+                        got = next(iter(arrays.values())).shape[0]
+                        if got != _local:
+                            raise ValueError(
+                                f"multi-host batcher must yield "
+                                f"{_local} rows/host (global batch "
+                                f"{hps.batch_size} / {nproc} hosts), "
+                                f"got {got}")
+                        return mesh_lib.global_batch_from_host_local(
+                            _plan, arrays)
+
+                    self._shard_batch = to_global
                 else:
                     self._shard_batch = functools.partial(
                         mesh_lib.shard_batch, plan)
@@ -212,23 +296,47 @@ class Trainer:
 
     def _train_loop(self, limit, last_ckpt, profile_dir, profile_start,
                     profile_stop) -> TrainState:
+        multihost = jax.process_count() > 1
+        if multihost and not limit:
+            # Collective ops (train step, checkpoint gather) must stay in
+            # lockstep; per-host data shards exhaust at different steps,
+            # so an until-exhausted run cannot be multi-host-safe.
+            raise ValueError(
+                "multi-host training requires an explicit num_steps limit "
+                "(per-host streams may end at different steps, desyncing "
+                "collectives)")
+        transfer = self._shard_batch if self._shard_batch is not None \
+            else jax.device_put
+        prefetcher = DevicePrefetcher(self.batcher, transfer)
+        try:
+            return self._train_steps(limit, last_ckpt, profile_dir,
+                                     profile_start, profile_stop,
+                                     prefetcher, multihost)
+        finally:
+            prefetcher.stop()
+
+    def _train_steps(self, limit, last_ckpt, profile_dir, profile_start,
+                     profile_stop, prefetcher, multihost) -> TrainState:
         profiling = False
+        # multi-host checkpoints trigger on STEP cadence (identical on all
+        # hosts) because save() is collective; single-host keeps the
+        # reference's save_model_secs wall-clock behavior.
+        checkpoint_steps = max(int(self.checkpoint_secs), 1) if multihost \
+            else 0
         while True:
             step = int(self.state.step)
             if limit and step >= limit:
                 break
-            batch = self.batcher.next_batch()
-            if batch is None:
+            item = prefetcher.next_batch()
+            if item is None:
                 log.info("batcher exhausted; stopping training at step %d", step)
                 break
+            batch, arrays = item
             if profile_dir and not profiling and step == profile_start:
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
                 log.info("profiler trace started -> %s", profile_dir)
             t0 = time.time()
-            arrays = batch.as_arrays()
-            if self._shard_batch is not None:
-                arrays = self._shard_batch(arrays)
             self.state, metrics = self._step_fn(self.state, arrays)
             loss = float(metrics.loss)
             t1 = time.time()
@@ -249,10 +357,13 @@ class Trainer:
                 jax.profiler.stop_trace()
                 profiling = False
                 log.info("profiler trace written to %s", profile_dir)
-            if self.checkpointer is not None and \
-                    time.time() - last_ckpt >= self.checkpoint_secs:
-                self.checkpointer.save(self.state)
-                last_ckpt = time.time()
+            if self.checkpointer is not None:
+                now_step = int(self.state.step)
+                due = (now_step % checkpoint_steps == 0) if multihost \
+                    else (time.time() - last_ckpt >= self.checkpoint_secs)
+                if due:
+                    self.checkpointer.save(self.state)
+                    last_ckpt = time.time()
         if profiling:
             jax.profiler.stop_trace()
         if self.checkpointer is not None:
@@ -281,8 +392,12 @@ class Evaluator:
             from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
 
             self._mesh_plan = mesh_lib.make_mesh(hps)
-            self._shard_batch = functools.partial(
-                mesh_lib.shard_batch, self._mesh_plan)
+            if jax.process_count() > 1:  # same per-host-shard rule as Trainer
+                self._shard_batch = functools.partial(
+                    mesh_lib.global_batch_from_host_local, self._mesh_plan)
+            else:
+                self._shard_batch = functools.partial(
+                    mesh_lib.shard_batch, self._mesh_plan)
             self._eval_fn = None  # built lazily per params structure
         else:
             self._eval_fn = jax.jit(make_eval_step(hps))
