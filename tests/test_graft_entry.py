@@ -13,12 +13,29 @@ def test_entry_traces():
     assert lowered is not None
 
 
-def test_dryrun_multichip_8():
+def test_dryrun_multichip_8(monkeypatch):
+    # the exact path the driver takes: scrubbed-env subprocess re-exec
+    monkeypatch.delenv("TS_DRYRUN_INPROC", raising=False)
     ge.dryrun_multichip(8)
 
 
-def test_dryrun_multichip_1():
+def test_dryrun_multichip_1(monkeypatch):
+    # in-process body (the conftest already pins the virtual CPU mesh)
+    monkeypatch.setenv("TS_DRYRUN_INPROC", "1")
     ge.dryrun_multichip(1)
+
+
+def test_scrubbed_env_strips_tpu_plugin(monkeypatch):
+    monkeypatch.setenv("PYTHONPATH", "/root/.axon_site:/other/path")
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=2 --foo")
+    env = ge._scrubbed_cpu_env(8)
+    assert ".axon_site" not in env["PYTHONPATH"]
+    assert "/other/path" in env["PYTHONPATH"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert "device_count=2" not in env["XLA_FLAGS"]
+    assert "--foo" in env["XLA_FLAGS"]
 
 
 def test_factor_mesh():
