@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Fetch the processed CNN/DailyMail dataset (finished_files.zip: chunked
+# tf.Example bins + vocab) — the same Google-Drive artifact the reference
+# fetches (/root/reference/data/cnn-dailymail/download_data.sh:1-29).
+#
+# Google Drive's large-file confirm flow changes over time; this uses the
+# current uuid/confirm form-token dance and is, like the reference script,
+# "not guaranteed to work indefinitely".  If the fetch fails, download
+# finished_files.zip manually (see data/cnn-dailymail/README.md in the
+# reference for the dataset recipe) and unzip it into DEST.
+#
+# Usage: scripts/download_data.sh [DEST_DIR]   (default ./data/cnn-dailymail)
+set -euo pipefail
+
+FILE_ID='0BzQ6rtO2VN95a0c3TlZCWkl3aU0'
+DEST="${1:-data/cnn-dailymail}"
+ZIP="finished_files.zip"
+
+mkdir -p "$DEST"
+cd "$DEST"
+
+fetch_gdrive() {
+  local id="$1" out="$2" base='https://drive.google.com/uc?export=download'
+  local cookies page token uuid
+  cookies="$(mktemp)"
+  page="$(mktemp)"
+  curl -sc "$cookies" -L "${base}&id=${id}" -o "$page"
+  # small files come straight through; large files return an HTML confirm
+  # form carrying confirm= and uuid= tokens
+  if grep -q 'download-form' "$page" 2>/dev/null; then
+    token="$(grep -o 'name="confirm" value="[^"]*"' "$page" | cut -d'"' -f4 || true)"
+    uuid="$(grep -o 'name="uuid" value="[^"]*"' "$page" | cut -d'"' -f4 || true)"
+    curl -Lb "$cookies" -o "$out" \
+      "https://drive.usercontent.google.com/download?id=${id}&export=download&confirm=${token:-t}&uuid=${uuid}"
+  else
+    mv "$page" "$out"
+  fi
+  rm -f "$cookies" "$page"
+}
+
+echo "Downloading ${ZIP} (CNN/DM finished_files) ..."
+fetch_gdrive "$FILE_ID" "$ZIP"
+unzip -o "$ZIP"
+rm -f "$ZIP"
+echo "Done: $(pwd)/finished_files"
+echo "Train with: python -m textsummarization_on_flink_tpu --mode=train \\"
+echo "  --data_path=$(pwd)/finished_files/chunked/train_* \\"
+echo "  --vocab_path=$(pwd)/finished_files/vocab --log_root=log --exp_name=exp"

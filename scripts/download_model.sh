@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Fetch the pretrained pointer-generator checkpoint
+# (pretrained_model_tf1.2.1.zip) — the same Google-Drive artifact the
+# reference fetches (/root/reference/log/download_model.sh:1-28) — and
+# print the command that imports it into this framework's checkpoint
+# format (checkpoint/tf1_import.py).
+#
+# Same caveat as download_data.sh: the Drive confirm flow is
+# "not guaranteed to work indefinitely"; on failure download the zip
+# manually and unzip into DEST.
+#
+# Usage: scripts/download_model.sh [DEST_DIR]   (default ./log)
+set -euo pipefail
+
+FILE_ID='0B7pQmm-OfDv7ZUhHZm9ZWEZidDg'
+DEST="${1:-log}"
+ZIP="pretrained_model_tf1.2.1.zip"
+
+mkdir -p "$DEST"
+cd "$DEST"
+
+fetch_gdrive() {
+  local id="$1" out="$2" base='https://drive.google.com/uc?export=download'
+  local cookies page token uuid
+  cookies="$(mktemp)"
+  page="$(mktemp)"
+  curl -sc "$cookies" -L "${base}&id=${id}" -o "$page"
+  if grep -q 'download-form' "$page" 2>/dev/null; then
+    token="$(grep -o 'name="confirm" value="[^"]*"' "$page" | cut -d'"' -f4 || true)"
+    uuid="$(grep -o 'name="uuid" value="[^"]*"' "$page" | cut -d'"' -f4 || true)"
+    curl -Lb "$cookies" -o "$out" \
+      "https://drive.usercontent.google.com/download?id=${id}&export=download&confirm=${token:-t}&uuid=${uuid}"
+  else
+    mv "$page" "$out"
+  fi
+  rm -f "$cookies" "$page"
+}
+
+echo "Downloading ${ZIP} ..."
+fetch_gdrive "$FILE_ID" "$ZIP"
+unzip -o "$ZIP"
+rm -f "$ZIP"
+
+CKPT_DIR="$(pwd)/pretrained_model_tf1.2.1"
+BUNDLE="$(ls "$CKPT_DIR"/*.index 2>/dev/null | head -1 | sed 's/\.index$//')"
+echo "Done: $CKPT_DIR"
+echo "Import into a servable train dir with:"
+echo "  python -m textsummarization_on_flink_tpu.checkpoint.tf1_import \\"
+echo "    ${BUNDLE:-$CKPT_DIR/<checkpoint-prefix>} log/exp/train"

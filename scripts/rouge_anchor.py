@@ -1,0 +1,83 @@
+"""ROUGE-vs-anchor harness: decode the CNN/DM test split with the imported
+pretrained checkpoint and compare against the See et al. paper numbers.
+
+The anchor is the ACL-2017 pointer-generator+coverage result the reference
+points at (~39.53 / 17.28 / 36.38 ROUGE-1/2/L F1; pointer-generator
+README "Looking for pretrained model?" note, data/cnn-dailymail/README.md:1
+paper link) — the published checkpoint itself scores "slightly lower".
+
+Requires the real artifacts (fetched via scripts/download_data.sh and
+scripts/download_model.sh):
+
+  python scripts/rouge_anchor.py \
+      --bundle log/pretrained_model_tf1.2.1/model-238410 \
+      --data 'data/cnn-dailymail/finished_files/chunked/test_*' \
+      --vocab data/cnn-dailymail/finished_files/vocab \
+      [--log_root /tmp/rouge_run] [--max_articles N]
+
+Exits 0 when ROUGE-L F1 is within --tolerance (default 0.5 points) of the
+anchor, 1 otherwise; always prints one JSON line with the scores.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ANCHOR = {"rouge_1": 39.53, "rouge_2": 17.28, "rouge_l": 36.38}
+
+
+def main(argv=None) -> int:
+    from textsummarization_on_flink_tpu.checkpoint import tf1_import
+    from textsummarization_on_flink_tpu.config import HParams
+    from textsummarization_on_flink_tpu.data.batcher import Batcher
+    from textsummarization_on_flink_tpu.data.vocab import Vocab
+    from textsummarization_on_flink_tpu.decode.decoder import BeamSearchDecoder
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bundle", required=True,
+                    help="TF1 checkpoint prefix (pretrained_model_tf1.2.1)")
+    ap.add_argument("--data", required=True,
+                    help="chunked test-split glob (test_*.bin)")
+    ap.add_argument("--vocab", required=True)
+    ap.add_argument("--log_root", default="/tmp/rouge_anchor")
+    ap.add_argument("--max_articles", type=int, default=0,
+                    help="0 = the full 11,490-article test split")
+    ap.add_argument("--tolerance", type=float, default=0.5)
+    args = ap.parse_args(argv)
+
+    train_dir = os.path.join(args.log_root, "anchor", "train")
+    print(f"importing {args.bundle} -> {train_dir}", file=sys.stderr)
+    tf1_import.import_to_train_dir(args.bundle, train_dir)
+
+    hps = HParams(mode="decode", single_pass=True, coverage=True,
+                  data_path=args.data, vocab_path=args.vocab,
+                  log_root=args.log_root, exp_name="anchor",
+                  batch_size=16)
+    vocab = Vocab(hps.vocab_path, hps.vocab_size)
+    batcher = Batcher(hps.data_path, vocab, hps, single_pass=True,
+                      decode_batch_mode="distinct")
+    decoder = BeamSearchDecoder(hps, vocab, batcher, train_dir=train_dir)
+    max_batches = (-(-args.max_articles // hps.batch_size)
+                   if args.max_articles else 0)
+    results = decoder.decode(with_rouge=True, max_batches=max_batches)
+    if results is None:
+        print(json.dumps({"error": "decode produced no ROUGE results"}))
+        return 1
+
+    scores = {k: round(results[k]["f_score"] * 100, 2)
+              for k in ("rouge_1", "rouge_2", "rouge_l")}
+    delta = {k: round(scores[k] - ANCHOR[k], 2) for k in scores}
+    ok = abs(delta["rouge_l"]) <= args.tolerance or \
+        delta["rouge_l"] > 0  # beating the anchor is never a failure
+    print(json.dumps({"metric": "rouge_vs_anchor", "scores": scores,
+                      "anchor": ANCHOR, "delta": delta, "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

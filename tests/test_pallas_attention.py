@@ -127,3 +127,50 @@ def test_blocked_kernel_long_sequence_distribution():
     attn = np.asarray(attn)
     np.testing.assert_allclose(attn.sum(axis=1), 1.0, atol=1e-4)
     assert (attn[:, 900:] == 0).all()
+
+
+def make_inputs_with_empty_row(B=3, T=37, D=24):
+    """Row 0 fully masked (an empty streamed article)."""
+    args = list(make_inputs(B=B, T=T, D=D))
+    mask = args[2].copy()
+    mask[0, :] = 0.0
+    args[2] = mask
+    return tuple(args)
+
+
+@pytest.mark.parametrize("use_coverage", [False, True])
+def test_fully_masked_row_is_finite_xla(use_coverage):
+    """ADVICE r1: an all-zero enc_padding_mask must give zero attention
+    and a finite context, not 0/0 NaN that trips the watchdog."""
+    args = make_inputs_with_empty_row()
+    ctx, attn = pa._attention_xla(*args, use_coverage)
+    assert np.isfinite(np.asarray(ctx)).all()
+    assert np.isfinite(np.asarray(attn)).all()
+    np.testing.assert_array_equal(np.asarray(attn)[0], 0.0)
+    # other rows unaffected: still proper distributions
+    np.testing.assert_allclose(np.asarray(attn)[1:].sum(axis=1), 1.0,
+                               atol=1e-5)
+
+
+def test_fully_masked_row_is_finite_simple_kernel():
+    args = make_inputs_with_empty_row()
+    ctx, attn = pa._attention_pallas(*args, True, interpret=True)
+    assert np.isfinite(np.asarray(ctx)).all()
+    assert np.isfinite(np.asarray(attn)).all()
+    np.testing.assert_array_equal(np.asarray(attn)[0], 0.0)
+
+
+def test_fully_masked_row_is_finite_blocked_kernel():
+    args = make_inputs_with_empty_row(B=2, T=64, D=24)
+    ctx, attn = pa._attention_pallas_blocked(*args, True, block_t=32,
+                                             interpret=True)
+    assert np.isfinite(np.asarray(ctx)).all()
+    assert np.isfinite(np.asarray(attn)).all()
+
+
+def test_fully_masked_row_is_finite_masked_softmax():
+    e = jnp.asarray(np.random.RandomState(0).randn(2, 9).astype(np.float32))
+    mask = jnp.asarray(np.stack([np.zeros(9), np.ones(9)]).astype(np.float32))
+    out = np.asarray(attn_ops.masked_softmax(e, mask))
+    assert np.isfinite(out).all()
+    np.testing.assert_array_equal(out[0], 0.0)

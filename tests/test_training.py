@@ -103,6 +103,39 @@ class TestTrainStep:
         with pytest.raises(NonFiniteLossError):
             trainer.train()
 
+    def test_nan_watchdog_debug_dumps_batch(self, tmp_path):
+        """--debug pins the metrics window to 1 step and dumps the exact
+        offending batch (the reference's tfdbg has_inf_or_nan hook,
+        run_summarization.py:216-218)."""
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="t", lr=1e6,
+                       debug=True)
+        vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+        batch = make_batch(hps, vocab)
+        trainer = Trainer(hps, vocab.size(), FixedBatcher(batch, 50))
+        assert trainer.metrics_every == 1
+        with pytest.raises(NonFiniteLossError):
+            trainer.train()
+        dumps = list((tmp_path / "t" / "train").glob("nan_batch_step*.npz"))
+        assert len(dumps) == 1
+        loaded = np.load(dumps[0])
+        np.testing.assert_array_equal(loaded["enc_batch"],
+                                      batch.as_arrays()["enc_batch"])
+
+    def test_metrics_window_writes_per_step_records(self, tmp_path):
+        """Deferred metrics fetch (one D2H sync per window) must still
+        produce one summary record per step."""
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="w")
+        vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+        batch = make_batch(hps, vocab)
+        trainer = Trainer(hps, vocab.size(), FixedBatcher(batch, 7),
+                          metrics_every=3)  # 7 steps -> 2 full + 1 partial
+        trainer.train()
+        import json
+
+        lines = (tmp_path / "w" / "train" / "events.jsonl").read_text() \
+            .strip().splitlines()
+        assert [json.loads(ln)["step"] for ln in lines] == list(range(1, 8))
+
     def test_coverage_objective_used(self, tmp_path):
         hps = hps_tiny(coverage=True)
         vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
@@ -138,6 +171,44 @@ class TestEvaluator:
         ev.batcher = FixedBatcher(batch, 2)
         ev.run(state.params, step=2)
         assert len(saved) == 1
+
+
+class TestDebugAndMultihostHelpers:
+    def test_apply_debug_mode_toggles_jax_debug_nans(self):
+        from textsummarization_on_flink_tpu.utils import apply_debug_mode
+
+        try:
+            apply_debug_mode(hps_tiny(debug=False))
+            assert not jax.config.jax_debug_nans
+            apply_debug_mode(hps_tiny(debug=True))
+            assert jax.config.jax_debug_nans
+        finally:
+            jax.config.update("jax_debug_nans", False)
+
+    def test_local_batch_hps_single_process_passthrough(self):
+        from textsummarization_on_flink_tpu.utils import local_batch_hps
+
+        hps = hps_tiny(batch_size=16)
+        assert local_batch_hps(hps) is hps
+
+    def test_local_batch_hps_divides(self, monkeypatch):
+        import textsummarization_on_flink_tpu.utils as utils_mod
+
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        hps = hps_tiny(batch_size=16)
+        assert utils_mod.local_batch_hps(hps).batch_size == 4
+        with pytest.raises(ValueError, match="divisible"):
+            utils_mod.local_batch_hps(hps_tiny(batch_size=6))
+
+    def test_multihost_rejects_single_pass(self, monkeypatch, tmp_path):
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="t",
+                       single_pass=True, num_steps=3)
+        vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+        batch = make_batch(hps, vocab)
+        trainer = Trainer(hps, vocab.size(), FixedBatcher(batch, 5))
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        with pytest.raises(ValueError, match="single_pass"):
+            trainer.train()
 
 
 def test_trainer_auto_shards_on_mesh(tmp_path):

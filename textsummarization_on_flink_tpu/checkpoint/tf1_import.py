@@ -122,3 +122,74 @@ def import_tf1_checkpoint(path: str, strict: bool = True) -> PyTree:
     shapes = reader.get_variable_to_shape_map()
     return import_tf1_arrays({n: reader.get_tensor(n) for n in shapes},
                              strict=strict)
+
+
+def infer_hps_from_params(params: PyTree, base: Optional[Any] = None):
+    """Derive the model dims the checkpoint was trained with: embedding
+    [V, E], encoder fused kernel [(E+H), 4H], so every dim is determined.
+    `base` supplies the non-architectural fields (paths, decode lengths)."""
+    from textsummarization_on_flink_tpu.config import HParams
+
+    base = base if base is not None else HParams()
+    vsize, emb = params["embedding"].shape
+    hidden = params["encoder"]["fw"]["kernel"].shape[1] // 4
+    has_cov = "w_c" in params["decoder"]["attention"]
+    return base.replace(vocab_size=int(vsize), emb_dim=int(emb),
+                        hidden_dim=int(hidden),
+                        coverage=bool(base.coverage or has_cov))
+
+
+def import_to_train_dir(bundle_path: str, train_dir: str,
+                        hps: Optional[Any] = None, strict: bool = True,
+                        seed: int = 0) -> str:
+    """End-to-end: TF1 bundle -> servable checkpoint in `train_dir`.
+
+    Adagrad accumulators are re-initialized (the reference's
+    restore_best_model drops them too, run_summarization.py:132-154);
+    a non-coverage checkpoint under coverage hps gets fresh coverage
+    params (convert_to_coverage_model semantics, :157-178).
+    Returns the saved checkpoint path.
+    """
+    import jax
+
+    from textsummarization_on_flink_tpu.checkpoint import (
+        checkpointer as ckpt_lib,
+    )
+    from textsummarization_on_flink_tpu.models import pointer_generator as pg
+    from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+
+    params = import_tf1_checkpoint(bundle_path, strict=strict)
+    hps = infer_hps_from_params(params, base=hps)
+    if hps.coverage and "w_c" not in params["decoder"]["attention"]:
+        params = pg.add_coverage_params(params, jax.random.PRNGKey(seed))
+    state = trainer_lib.init_train_state(hps, hps.vocab_size, params=params)
+    return ckpt_lib.Checkpointer(train_dir, hps=hps).save(state)
+
+
+def _main(argv=None) -> int:
+    import argparse
+
+    from textsummarization_on_flink_tpu.config import HParams
+
+    ap = argparse.ArgumentParser(
+        description="Import a TF1 pointer-generator checkpoint bundle "
+                    "(pretrained_model_tf1.2.1) into a servable train dir.")
+    ap.add_argument("bundle", help="TF1 checkpoint prefix (path minus "
+                                   ".index/.data-* suffix)")
+    ap.add_argument("train_dir", help="output directory (the --log_root/"
+                                      "--exp_name/train the decoder reads)")
+    ap.add_argument("--coverage", action="store_true",
+                    help="add fresh coverage params if the bundle lacks "
+                         "them (convert_to_coverage_model semantics)")
+    ap.add_argument("--lenient", action="store_true",
+                    help="ignore unmapped variables instead of failing")
+    args = ap.parse_args(argv)
+    path = import_to_train_dir(
+        args.bundle, args.train_dir,
+        hps=HParams(coverage=args.coverage), strict=not args.lenient)
+    print(f"imported -> {path}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(_main())

@@ -50,8 +50,11 @@ def setup_training(hps: HParams, vocab: Vocab,
     checkpoints (save_model_secs=60 parity)."""
     from textsummarization_on_flink_tpu.parallel import distributed
 
+    from textsummarization_on_flink_tpu.utils import local_batch_hps
+
     _, train_dir, _ = _dirs(hps)
-    batcher = batcher or Batcher(hps.data_path, vocab, hps,
+    # multi-host: the batcher feeds THIS host's shard of the global batch
+    batcher = batcher or Batcher(hps.data_path, vocab, local_batch_hps(hps),
                                  single_pass=hps.single_pass)
     # Checkpointer.save is collective-then-chief-writes, so every host
     # holds one (the reference's is_chief MonitoredTrainingSession role,
@@ -76,9 +79,12 @@ def run_eval(hps: HParams, vocab: Vocab, max_iters: int = 0,
     checkpoint, evaluates one batch, updates the smoothed loss, and saves
     `bestmodel` on improvement.  max_iters=0 runs forever (reference
     behavior); tests pass a bound."""
+    from textsummarization_on_flink_tpu.utils import local_batch_hps
+
     eval_hps = hps.replace(mode="eval")
     _, train_dir, eval_dir = _dirs(hps)
-    batcher = batcher or Batcher(hps.data_path, vocab, eval_hps,
+    batcher = batcher or Batcher(hps.data_path, vocab,
+                                 local_batch_hps(eval_hps),
                                  single_pass=False)
     evaluator = trainer_lib.Evaluator(
         eval_hps, vocab.size(), batcher, eval_dir=eval_dir,
@@ -132,6 +138,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     hps = HParams.from_argv(argv)
     hps.validate()
     log.info("Starting summarization in %s mode...", hps.mode)
+    from textsummarization_on_flink_tpu.utils import apply_debug_mode
+
+    apply_debug_mode(hps)  # --debug -> jax_debug_nans (ref :216-218)
 
     # surgery flags run-and-exit (:341-349 equivalents)
     _, train_dir, eval_dir = _dirs(hps)

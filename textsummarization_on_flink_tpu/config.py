@@ -75,6 +75,13 @@ class HParams:
     tp: int = 1  # tensor-parallel mesh axis size (output projection)
     sp: int = 1  # sequence/context-parallel mesh axis size
     model_family: str = "pointer_generator"  # or "transformer"
+    # metrics fetch cadence in steps (one blocking D2H sync per window);
+    # 0 = auto: 1 under --debug, 10 otherwise
+    metrics_every: int = 0
+    # multi-host checkpoint cadence in STEPS (collective saves must fire
+    # at the same step on every host); 0 on a multi-host run falls back
+    # to reinterpreting the 60s save_model_secs as a step count, loudly
+    checkpoint_steps: int = 0
 
     # -- derived --
     @property

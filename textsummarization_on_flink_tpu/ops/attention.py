@@ -35,7 +35,9 @@ def masked_softmax(e: Array, enc_mask: Array) -> Array:
     e = e - jax.lax.stop_gradient(jnp.max(e, axis=-1, keepdims=True))
     attn = jax.nn.softmax(e, axis=-1)
     attn = attn * enc_mask
-    denom = jnp.sum(attn, axis=-1, keepdims=True)
+    # fully-masked row (empty article): clamp the 0 denominator so the
+    # result is zero attention, not NaN
+    denom = jnp.maximum(jnp.sum(attn, axis=-1, keepdims=True), 1e-30)
     return attn / denom
 
 

@@ -69,7 +69,9 @@ def _kernel(es_ref, ef_ref, mask_ref, df_ref, cov_ref, v_ref, wc_ref,
     m = jnp.max(e)
     p = jnp.exp(e - m) * (mask > 0)  # exp(NEG-m) could be denormal; zero it
     l = jnp.sum(p)
-    a = p / l
+    # fully-masked row (empty streamed article): l=0 would give NaN via
+    # 0/0 and poison p_gen/final_dist; clamp -> zero attention instead
+    a = p / jnp.maximum(l, 1e-30)
     attn_ref[0, :] = a
     # context: [1, T] @ [T, D] on the MXU
     ctx_ref[0, :] = jnp.dot(a[None, :], es_ref[0],
@@ -87,7 +89,8 @@ def _attention_xla(enc_states, enc_feats, enc_mask, dec_feats, coverage,
     e = jnp.where(enc_mask > 0, e, NEG)
     e = e - jax.lax.stop_gradient(jnp.max(e, axis=-1, keepdims=True))
     p = jnp.exp(e) * (enc_mask > 0)
-    attn = p / jnp.sum(p, axis=-1, keepdims=True)
+    # fully-masked row: clamp the l=0 denominator (match the kernels)
+    attn = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
     context = jnp.einsum("bt,btd->bd", attn, enc_states)
     return context, attn
 
@@ -182,7 +185,8 @@ def _blocked_kernel(es_ref, ef_ref, mask_ref, df_ref, cov_ref, v_ref, wc_ref,
 
     @pl.when(j == nT - 1)
     def _finish():
-        ctx_ref[0, :] = ctx_scr[0, :] / l_scr[0]
+        # clamp like the simple kernel: fully-masked row has l=0
+        ctx_ref[0, :] = ctx_scr[0, :] / jnp.maximum(l_scr[0], 1e-30)
         stat_ref[0, 0] = m_scr[0]
         stat_ref[0, 1] = l_scr[0]
 
@@ -245,7 +249,7 @@ def _attention_pallas_blocked(enc_states, enc_feats, enc_mask, dec_feats,
       cov.astype(jnp.float32), vp.astype(jnp.float32),
       wcp.astype(jnp.float32))
     m_fin = stat[:, 0:1]
-    l_fin = stat[:, 1:2]
+    l_fin = jnp.maximum(stat[:, 1:2], 1e-30)  # fully-masked row: l=0
     corr = jnp.exp(jnp.repeat(mblk, block_t, axis=1) - m_fin)  # [B, Tp]
     attn = p * corr / l_fin
     return ctx[:, :D], attn[:, :T]

@@ -280,6 +280,32 @@ def make_sharded_beam_search(plan: MeshPlan,
                    out_shardings=out_sh)
 
 
+def make_host_local_transfer(plan: MeshPlan, global_batch_size: int,
+                             label: str = "train"):
+    """Batch-transfer fn for one host of a multi-host run: validates this
+    host's row count (batch_size/process_count) then assembles the global
+    dp-sharded batch.  Shared by Trainer and Evaluator so the check and
+    the error text cannot drift."""
+    import jax
+
+    nproc = jax.process_count()
+    if global_batch_size % nproc != 0:
+        raise ValueError(f"{label} batch_size={global_batch_size} must be "
+                         f"divisible by process_count={nproc}")
+    local_rows = global_batch_size // nproc
+
+    def to_global(arrays: Dict[str, Any]) -> Dict[str, Any]:
+        got = next(iter(arrays.values())).shape[0]
+        if got != local_rows:
+            raise ValueError(
+                f"multi-host {label} batcher must yield {local_rows} "
+                f"rows/host (global batch {global_batch_size} / {nproc} "
+                f"hosts), got {got}")
+        return global_batch_from_host_local(plan, arrays)
+
+    return to_global
+
+
 def global_batch_from_host_local(plan: MeshPlan,
                                  arrays: Dict[str, Any]) -> Dict[str, Any]:
     """Multi-host batch assembly: each process contributes ITS OWN rows

@@ -288,8 +288,11 @@ class SummarizationEstimator(Estimator,
         return Vocab(hps.vocab_path, hps.vocab_size)
 
     def fit(self, source: Source) -> SummarizationModel:
+        from textsummarization_on_flink_tpu.utils import apply_debug_mode
+
         hps = self._hps()
         hps.validate()
+        apply_debug_mode(hps)  # --debug -> jax_debug_nans (ref :216-218)
         vocab = self._vocab(hps)
         sel = self.get_train_selected_cols()  # uuid, article, reference
         in_schema = source.schema.select(sel)

@@ -215,10 +215,28 @@ class Trainer:
                  state: Optional[TrainState] = None,
                  checkpointer: Optional[Any] = None,
                  checkpoint_secs: float = 60.0,
+                 checkpoint_steps: int = 0,
+                 metrics_every: int = 0,
                  train_dir: Optional[str] = None,
                  step_fn: Optional[Callable] = None):
         self.hps = hps
         self.batcher = batcher
+        # Metrics cadence: fetching metrics is a blocking D2H sync that
+        # serializes dispatch (and defeats DevicePrefetcher), so losses
+        # are fetched/logged/NaN-checked in windows of `metrics_every`
+        # steps.  0 = auto: per-step under --debug (exact watchdog, the
+        # reference's per-step logging), every 10 steps otherwise.  The
+        # summary JSONL still gets one record per step either way.
+        self.metrics_every = (metrics_every
+                              or getattr(hps, "metrics_every", 0)
+                              or (1 if hps.debug else 10))
+        # Multi-host checkpoints trigger on STEP cadence (identical on all
+        # hosts — save() is collective); `checkpoint_steps` (kwarg or the
+        # --checkpoint_steps flag) sets it explicitly.  Single-host keeps
+        # the reference's save_model_secs wall-clock behavior
+        # (run_summarization.py:198).
+        self.checkpoint_steps = (checkpoint_steps
+                                 or getattr(hps, "checkpoint_steps", 0))
         self.state = state if state is not None else init_train_state(hps, vsize)
         self.checkpointer = checkpointer
         self.checkpoint_secs = checkpoint_secs
@@ -236,30 +254,13 @@ class Trainer:
                 mesh_lib.validate_divisibility(hps, self.state.params)
                 plan = mesh_lib.make_mesh(hps)
                 self.state = mesh_lib.shard_train_state(plan, self.state)
-                nproc = jax.process_count()
-                if nproc > 1:
+                if jax.process_count() > 1:
                     # Each host's batcher must feed ITS shard of the
                     # global batch: batch_size/process_count rows per
                     # host (configure the batcher with the LOCAL size;
                     # hps.batch_size stays the global batch).
-                    if hps.batch_size % nproc != 0:
-                        raise ValueError(
-                            f"batch_size={hps.batch_size} must be "
-                            f"divisible by process_count={nproc}")
-                    local_rows = hps.batch_size // nproc
-
-                    def to_global(arrays, _local=local_rows, _plan=plan):
-                        got = next(iter(arrays.values())).shape[0]
-                        if got != _local:
-                            raise ValueError(
-                                f"multi-host batcher must yield "
-                                f"{_local} rows/host (global batch "
-                                f"{hps.batch_size} / {nproc} hosts), "
-                                f"got {got}")
-                        return mesh_lib.global_batch_from_host_local(
-                            _plan, arrays)
-
-                    self._shard_batch = to_global
+                    self._shard_batch = mesh_lib.make_host_local_transfer(
+                        plan, hps.batch_size, label="train")
                 else:
                     self._shard_batch = functools.partial(
                         mesh_lib.shard_batch, plan)
@@ -305,6 +306,15 @@ class Trainer:
                 "multi-host training requires an explicit num_steps limit "
                 "(per-host streams may end at different steps, desyncing "
                 "collectives)")
+        if multihost and getattr(self.hps, "single_pass", False):
+            # Even with a limit, a finite per-host stream can end early on
+            # one host while the others still issue collective steps —
+            # that host would then enter the collective checkpoint save
+            # and hang the job.
+            raise ValueError(
+                "multi-host training cannot use single_pass (finite "
+                "per-host streams end at different steps, desyncing "
+                "collectives); stream an infinite shuffled pass instead")
         transfer = self._shard_batch if self._shard_batch is not None \
             else jax.device_put
         prefetcher = DevicePrefetcher(self.batcher, transfer)
@@ -315,55 +325,129 @@ class Trainer:
         finally:
             prefetcher.stop()
 
+    def _flush_metrics(self, pending, window_dt) -> None:
+        """Fetch a window of device-resident metrics in one D2H transfer,
+        log + summarize each step, and run the NaN watchdog
+        (train.py:107-108 parity, detection deferred <= metrics_every
+        steps unless --debug pins the window to 1)."""
+        if not pending:
+            return
+        fetched = jax.device_get([m for _, m, _ in pending])
+        step_time = window_dt / len(pending)
+        log.info("seconds for training step: %.3f (avg over %d)",
+                 step_time, len(pending))
+        for (step, _, arrays), m in zip(pending, fetched):
+            loss = float(m.loss)
+            log.info("loss: %f", loss)
+            scalars = dict(loss=loss, total_loss=float(m.total_loss),
+                           global_norm=float(m.global_norm),
+                           step_time=step_time)
+            if self.hps.coverage:
+                cl = float(m.coverage_loss)
+                log.info("coverage_loss: %f", cl)
+                scalars["coverage_loss"] = cl
+            if not np.isfinite(loss):
+                self._dump_nan_batch(step, arrays)
+                raise NonFiniteLossError(
+                    f"Loss is not finite. Stopping. "
+                    f"(step {step}, loss {loss})")
+            self.writer.scalars(step + 1, **scalars)
+
+    def _dump_nan_batch(self, step: int, arrays) -> None:
+        """--debug: persist the batch that produced a non-finite loss
+        (the reference wires tfdbg's has_inf_or_nan filter here,
+        run_summarization.py:216-218)."""
+        if not self.hps.debug or arrays is None:
+            return
+        path = os.path.join(self.train_dir, f"nan_batch_step{step}.npz")
+        try:
+            os.makedirs(self.train_dir, exist_ok=True)
+            np.savez(path, **{k: np.asarray(v) for k, v in arrays.items()})
+            log.error("non-finite loss at step %d; offending batch "
+                      "dumped to %s", step, path)
+        except Exception:  # the watchdog error must still propagate
+            log.exception("failed to dump NaN batch")
+
     def _train_steps(self, limit, last_ckpt, profile_dir, profile_start,
                      profile_stop, prefetcher, multihost) -> TrainState:
         profiling = False
-        # multi-host checkpoints trigger on STEP cadence (identical on all
-        # hosts) because save() is collective; single-host keeps the
-        # reference's save_model_secs wall-clock behavior.
-        checkpoint_steps = max(int(self.checkpoint_secs), 1) if multihost \
-            else 0
+        if multihost:
+            if self.checkpoint_steps > 0:
+                checkpoint_steps = self.checkpoint_steps
+            else:
+                checkpoint_steps = max(int(self.checkpoint_secs), 1)
+                if self.checkpointer is not None:
+                    log.warning(
+                        "multi-host run without checkpoint_steps: falling "
+                        "back to one checkpoint every %d STEPS (the "
+                        "checkpoint_secs=%g value reinterpreted; pass "
+                        "checkpoint_steps= for an explicit cadence)",
+                        checkpoint_steps, self.checkpoint_secs)
+        else:
+            checkpoint_steps = 0
+        flush_every = max(self.metrics_every, 1)
+        # metrics stay on device until flushed; keeping the (tiny) input
+        # arrays alongside lets --debug dump the exact offending batch
+        pending = []  # [(step, device_metrics, arrays)]
+        window_t0 = time.time()
+        # ONE device sync to learn the resume step; from here the counter
+        # is tracked host-side (+1 per dispatched step) so the loop never
+        # blocks on state.step and dispatch can run ahead of the device
+        step = int(self.state.step)
         while True:
-            step = int(self.state.step)
             if limit and step >= limit:
                 break
             item = prefetcher.next_batch()
             if item is None:
+                if multihost:
+                    raise RuntimeError(
+                        f"batcher exhausted at step {step} before the "
+                        f"num_steps={limit} limit on a multi-host run; "
+                        f"other hosts may still be issuing collectives — "
+                        f"aborting instead of desyncing")
                 log.info("batcher exhausted; stopping training at step %d", step)
                 break
             batch, arrays = item
             if profile_dir and not profiling and step == profile_start:
+                self._flush_metrics(pending, time.time() - window_t0)
+                pending = []
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
+                window_t0 = time.time()
                 log.info("profiler trace started -> %s", profile_dir)
-            t0 = time.time()
-            self.state, metrics = self._step_fn(self.state, arrays)
-            loss = float(metrics.loss)
-            t1 = time.time()
-            log.info("seconds for training step: %.3f", t1 - t0)
-            log.info("loss: %f", loss)
-            if not np.isfinite(loss):
-                raise NonFiniteLossError(f"Loss is not finite. Stopping. "
-                                         f"(step {step}, loss {loss})")
-            scalars = dict(loss=loss, total_loss=float(metrics.total_loss),
-                           global_norm=float(metrics.global_norm),
-                           step_time=t1 - t0)
-            if self.hps.coverage:
-                cl = float(metrics.coverage_loss)
-                log.info("coverage_loss: %f", cl)
-                scalars["coverage_loss"] = cl
-            self.writer.scalars(int(self.state.step), **scalars)
-            if profiling and step >= profile_stop:
+            try:
+                self.state, metrics = self._step_fn(self.state, arrays)
+            except FloatingPointError as e:
+                # jax_debug_nans (--debug) raises inside the step with the
+                # op-level location; still dump the offending batch and
+                # surface the usual watchdog error type
+                self._dump_nan_batch(step, arrays)
+                raise NonFiniteLossError(
+                    f"Loss is not finite. Stopping. (step {step}; "
+                    f"jax_debug_nans trace above)") from e
+            pending.append((step, metrics,
+                            arrays if self.hps.debug else None))
+            step += 1
+            if len(pending) >= flush_every:
+                self._flush_metrics(pending, time.time() - window_t0)
+                pending = []
+                window_t0 = time.time()
+            if profiling and step > profile_stop:
                 jax.profiler.stop_trace()
                 profiling = False
                 log.info("profiler trace written to %s", profile_dir)
             if self.checkpointer is not None:
-                now_step = int(self.state.step)
-                due = (now_step % checkpoint_steps == 0) if multihost \
+                due = (step % checkpoint_steps == 0) if multihost \
                     else (time.time() - last_ckpt >= self.checkpoint_secs)
                 if due:
+                    # the save fetches state anyway; fold the metrics
+                    # flush into the same sync point
+                    self._flush_metrics(pending, time.time() - window_t0)
+                    pending = []
                     self.checkpointer.save(self.state)
                     last_ckpt = time.time()
+                    window_t0 = time.time()
+        self._flush_metrics(pending, time.time() - window_t0)
         if profiling:
             jax.profiler.stop_trace()
         if self.checkpointer is not None:
@@ -393,8 +477,8 @@ class Evaluator:
 
             self._mesh_plan = mesh_lib.make_mesh(hps)
             if jax.process_count() > 1:  # same per-host-shard rule as Trainer
-                self._shard_batch = functools.partial(
-                    mesh_lib.global_batch_from_host_local, self._mesh_plan)
+                self._shard_batch = mesh_lib.make_host_local_transfer(
+                    self._mesh_plan, hps.batch_size, label="eval")
             else:
                 self._shard_batch = functools.partial(
                     mesh_lib.shard_batch, self._mesh_plan)

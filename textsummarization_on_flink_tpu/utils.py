@@ -8,3 +8,31 @@ import os
 def get_project_root_dir() -> str:
     """The process working directory (SysUtils.java:4-6 `user.dir`)."""
     return os.getcwd()
+
+
+def apply_debug_mode(hps) -> None:
+    """Wire the --debug flag: the reference attaches tfdbg's
+    has_inf_or_nan filter (run_summarization.py:88,216-218); the JAX
+    equivalent is jax_debug_nans, which re-runs the offending op
+    un-jitted and raises at the first non-finite intermediate.  (The
+    Trainer additionally dumps the offending batch under --debug.)"""
+    if getattr(hps, "debug", False):
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+
+
+def local_batch_hps(hps):
+    """Per-host view of a global config for BATCHER construction: on a
+    multi-host run each host's input pipeline must yield its own
+    batch_size/process_count rows (the mesh/step functions keep the
+    GLOBAL hps.batch_size)."""
+    import jax
+
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return hps
+    if hps.batch_size % nproc != 0:
+        raise ValueError(f"batch_size={hps.batch_size} must be divisible "
+                         f"by process_count={nproc}")
+    return hps.replace(batch_size=hps.batch_size // nproc)
