@@ -173,3 +173,76 @@ class TestBatcher:
         batch = b.next_batch()
         assert batch is not None
         assert batch.enc_batch.shape == (2, 8)
+
+    def test_tail_padding_rows_tagged(self, tmp_path):
+        """Padding repeats carry real_mask=False; real rows sum to the
+        dataset size even after length-bucket sorting reorders them."""
+        v = make_vocab()
+        hps = small_hps(batch_size=4, mode="train")
+        pattern = _write_dataset(tmp_path, v, n=10)
+        b = Batcher(pattern, v, hps, single_pass=True)
+        real = 0
+        while True:
+            batch = b.next_batch()
+            if batch is None:
+                break
+            assert len(batch.real_mask) == 4
+            real += sum(batch.real_mask)
+        assert real == 10  # 12 rows shipped, 2 tagged as padding
+
+    def test_decode_repeat_mode_real_mask(self, tmp_path):
+        v = make_vocab()
+        hps = small_hps(batch_size=4, mode="decode")
+        pattern = _write_dataset(tmp_path, v, n=2)
+        b = Batcher(pattern, v, hps, single_pass=True,
+                    decode_batch_mode="repeat")
+        batch = b.next_batch()
+        # beam repetition: one real row, B-1 tagged repeats
+        assert batch.real_mask == [True, False, False, False]
+
+    def test_decode_distinct_trickle_padding_tagged(self):
+        v = make_vocab()
+        hps = small_hps(batch_size=4, mode="decode")
+
+        def source():
+            yield "the cat sat", "<s> the . </s>"
+            yield "the cat sat", "<s> the . </s>"  # identical on purpose
+
+        b = Batcher("", v, hps, single_pass=True,
+                    decode_batch_mode="distinct", example_source=source)
+        batch = b.next_batch()
+        # two REAL identical rows kept distinct; 2 padding rows tagged
+        assert batch.real_mask == [True, True, False, False]
+
+    def test_producer_error_propagates_to_next_batch(self):
+        v = make_vocab()
+        hps = small_hps(batch_size=2, mode="train")
+
+        def bad_source():
+            yield "the cat", "<s> the . </s>"
+            raise ValueError("stream backend exploded")
+
+        b = Batcher("", v, hps, single_pass=False, watch_interval=0.1,
+                    example_source=bad_source)
+        with pytest.raises(RuntimeError, match="producer thread failed"):
+            for _ in range(50):  # a batch may already be queued
+                if b.next_batch() is None:
+                    break
+        assert isinstance(b._fill_error, ValueError)
+
+    def test_non_single_pass_exhaustion_surfaces(self):
+        """An exhausted generator with single_pass off is an error the
+        CONSUMER sees (not a silent respawn loop, reference
+        batcher.py:343-360)."""
+        v = make_vocab()
+        hps = small_hps(batch_size=2, mode="train")
+
+        def finite_source():
+            yield "the cat", "<s> the . </s>"
+
+        b = Batcher("", v, hps, single_pass=False, watch_interval=0.1,
+                    example_source=finite_source)
+        with pytest.raises(RuntimeError, match="producer thread failed"):
+            for _ in range(50):
+                if b.next_batch() is None:
+                    break

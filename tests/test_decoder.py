@@ -142,6 +142,28 @@ def test_decode_batch_emits_valid_words(tmp_path, vocab, train_dir):
             assert w != "[STOP]"
 
 
+def test_identical_input_rows_get_one_result_each(tmp_path, vocab, train_dir):
+    """Two legitimately identical input rows (same uuid AND article — e.g.
+    a retried request) must each produce an output row; only batcher-tagged
+    padding rows are dropped (VERDICT r1 weak #5)."""
+    hps = HPS.replace(single_pass=False)
+
+    def source():
+        for _ in range(2):
+            yield ("uuid-dup", article(0), abstract(0), "ref")
+
+    batcher = Batcher("", vocab, hps, single_pass=True,
+                      decode_batch_mode="distinct", example_source=source)
+    d = dec_lib.BeamSearchDecoder(hps, vocab, batcher, train_dir=train_dir,
+                                  decode_root=str(tmp_path),
+                                  max_ckpt_retries=0)
+    batch = batcher.next_batch()
+    assert batch.real_mask == [True, True]
+    results = d.decode_batch(batch)
+    assert len(results) == 2
+    assert [r.uuid for r in results] == ["uuid-dup", "uuid-dup"]
+
+
 def test_decoder_multichip_dp(tmp_path, vocab, train_dir):
     """BeamSearchDecoder with dp>1 serves through the sharded search."""
     hps = HPS.replace(single_pass=False, dp=4, batch_size=4)

@@ -71,14 +71,24 @@ class Batcher:
             self._num_batch_q_threads = 4
             self._bucketing_cache_size = 100
 
+        # First producer failure is recorded here and re-raised from
+        # next_batch() — the consumer sees the real error instead of the
+        # watcher respawning a thread that instantly re-dies (the
+        # reference's worst habit, batcher.py:343-360; same contract as
+        # the estimator's _BridgeFeeder.raise_if_failed).
+        self._fill_error: Optional[BaseException] = None
+        self._fill_error_lock = threading.Lock()
+
         self._example_q_threads = []
         for _ in range(self._num_example_q_threads):
-            t = threading.Thread(target=self._fill_example_queue, daemon=True)
+            t = threading.Thread(target=self._run_producer,
+                                 args=(self._fill_example_queue,), daemon=True)
             self._example_q_threads.append(t)
             t.start()
         self._batch_q_threads = []
         for _ in range(self._num_batch_q_threads):
-            t = threading.Thread(target=self._fill_batch_queue, daemon=True)
+            t = threading.Thread(target=self._run_producer,
+                                 args=(self._fill_batch_queue,), daemon=True)
             self._batch_q_threads.append(t)
             t.start()
 
@@ -88,18 +98,28 @@ class Batcher:
             self._watch_thread.start()
 
     # -- consumer API --
+    def raise_if_failed(self) -> None:
+        """Re-raise the first producer-thread failure in the consumer."""
+        err = self._fill_error
+        if err is not None:
+            raise RuntimeError(
+                "batcher producer thread failed; see chained cause") from err
+
     def next_batch(self) -> Optional[Batch]:
         """Next Batch, or None when a single_pass dataset is exhausted.
 
         Polls rather than blocking indefinitely: end-of-stream can arrive
         AFTER a consumer is already parked in get() (the source closes with
         no further batches), so the wait must re-check _finished_reading.
+        Raises if a producer thread died with an error (instead of waiting
+        forever on a queue nobody is filling).
         """
         warned = False
         while True:
             try:
                 return self._batch_queue.get(timeout=0.2)
             except queue.Empty:
+                self.raise_if_failed()
                 if not warned:
                     log.warning(
                         "Bucket input queue is empty when calling next_batch. "
@@ -113,6 +133,17 @@ class Batcher:
                         return None
 
     # -- producers --
+    def _run_producer(self, fn: Callable[[], None]) -> None:
+        """Thread body: run `fn`, recording the first failure for the
+        consumer instead of letting it vanish in a daemon thread."""
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 — must capture everything
+            with self._fill_error_lock:
+                if self._fill_error is None:
+                    self._fill_error = e
+            log.error("batcher producer thread failed: %r", e)
+
     def _text_pairs(self) -> Iterator[Tuple[str, ...]]:
         """Yields (article, abstract) or, from a streaming source,
         (uuid, article, abstract, reference) with passthrough columns
@@ -160,6 +191,10 @@ class Batcher:
             try:
                 return self._example_queue.get(timeout=0.2)
             except queue.Empty:
+                if self._fill_error is not None:
+                    # an example thread died; propagate so this batch
+                    # thread exits too instead of waiting forever
+                    raise RuntimeError("example producer thread failed")
                 if self._single_pass and self._finished_reading:
                     return None
                 waited += 0.2
@@ -178,24 +213,31 @@ class Batcher:
                     inputs.append(ex)
                 if not inputs:
                     break  # single_pass exhausted
-                if self._single_pass and len(inputs) % hps.batch_size != 0:
+                rows = [(ex, True) for ex in inputs]
+                if self._single_pass and len(rows) % hps.batch_size != 0:
                     # pad the tail batch by repeating the last example so the
-                    # static batch shape holds; consumers can drop repeats
-                    pad = hps.batch_size - len(inputs) % hps.batch_size
-                    inputs.extend([inputs[-1]] * pad)
-                inputs.sort(key=lambda ex: ex.enc_len)  # length bucketing
-                batches = [inputs[i : i + hps.batch_size]
-                           for i in range(0, len(inputs), hps.batch_size)]
+                    # static batch shape holds; padding rows are tagged
+                    # real=False so consumers drop exactly these (never a
+                    # legitimate duplicate input)
+                    pad = hps.batch_size - len(rows) % hps.batch_size
+                    rows.extend([(rows[-1][0], False)] * pad)
+                rows.sort(key=lambda r: r[0].enc_len)  # length bucketing
+                batches = [rows[i : i + hps.batch_size]
+                           for i in range(0, len(rows), hps.batch_size)]
                 if not self._single_pass:
                     random.shuffle(batches)
                 for b in batches:
-                    self._batch_queue.put(Batch(b, hps, self._vocab))
+                    self._batch_queue.put(Batch(
+                        [r[0] for r in b], hps, self._vocab,
+                        real_mask=[r[1] for r in b]))
             elif self._decode_batch_mode == "repeat":
                 ex = self._get_example()
                 if ex is None:
                     break
                 b = [ex] * hps.batch_size
-                self._batch_queue.put(Batch(b, hps, self._vocab))
+                mask = [True] + [False] * (hps.batch_size - 1)
+                self._batch_queue.put(Batch(b, hps, self._vocab,
+                                            real_mask=mask))
             else:  # 'distinct': fill a whole batch of different articles
                 exs = []
                 first = self._get_example()  # wait for the first article
@@ -210,24 +252,34 @@ class Batcher:
                     if ex is None:
                         break
                     exs.append(ex)
+                n_real = len(exs)
                 while len(exs) < hps.batch_size:
                     exs.append(exs[-1])
-                self._batch_queue.put(Batch(exs, hps, self._vocab))
+                mask = [i < n_real for i in range(hps.batch_size)]
+                self._batch_queue.put(Batch(exs, hps, self._vocab,
+                                            real_mask=mask))
 
     def _watch_threads(self) -> None:
         while True:
             time.sleep(self._watch_interval)
+            if self._fill_error is not None:
+                # producers died with a real error: stop supervising and
+                # let next_batch() surface it — respawning a thread that
+                # instantly re-raises every interval helps nobody
+                return
             for idx, t in enumerate(self._example_q_threads):
                 if not t.is_alive():
                     log.error("Found example queue thread dead. Restarting.")
-                    new_t = threading.Thread(target=self._fill_example_queue,
-                                             daemon=True)
+                    new_t = threading.Thread(
+                        target=self._run_producer,
+                        args=(self._fill_example_queue,), daemon=True)
                     self._example_q_threads[idx] = new_t
                     new_t.start()
             for idx, t in enumerate(self._batch_q_threads):
                 if not t.is_alive():
                     log.error("Found batch queue thread dead. Restarting.")
-                    new_t = threading.Thread(target=self._fill_batch_queue,
-                                             daemon=True)
+                    new_t = threading.Thread(
+                        target=self._run_producer,
+                        args=(self._fill_batch_queue,), daemon=True)
                     self._batch_q_threads[idx] = new_t
                     new_t.start()

@@ -120,10 +120,22 @@ class Batch:
     """
 
     def __init__(self, example_list: Sequence[SummaryExample], hps: HParams,
-                 vocab: Vocab, enc_steps: Optional[int] = None):
+                 vocab: Vocab, enc_steps: Optional[int] = None,
+                 real_mask: Optional[Sequence[bool]] = None):
+        """``real_mask[i]`` is False for rows that are padding repeats
+        (beam repetition in decode 'repeat' mode, tail/trickle padding) —
+        consumers emit one result per True row, so two legitimately
+        identical input rows still produce two outputs."""
         if len(example_list) != hps.batch_size:
             raise ValueError(
                 f"expected {hps.batch_size} examples, got {len(example_list)}")
+        if real_mask is not None and len(real_mask) != len(example_list):
+            raise ValueError(
+                f"real_mask has {len(real_mask)} entries for "
+                f"{len(example_list)} examples")
+        self.real_mask: List[bool] = (
+            list(real_mask) if real_mask is not None
+            else [True] * len(example_list))
         self.pad_id = PAD_ID
         B = hps.batch_size
         T_enc = enc_steps if enc_steps is not None else hps.max_enc_steps

@@ -184,8 +184,11 @@ class BeamSearchDecoder:
     # -- decoding --
     def decode_batch(self, batch: Batch) -> List[DecodedResult]:
         """One device dispatch for the whole batch; returns one result per
-        DISTINCT article (decode-mode batches may repeat one article
-        beam_size times, batcher.py:344-347 — repeats are collapsed)."""
+        REAL input row (``batch.real_mask``).  Padding rows — beam
+        repetition in decode 'repeat' mode (batcher.py:344-347) and
+        trickle/tail padding — are tagged by the batcher and dropped here;
+        two legitimately identical input rows each get a result, matching
+        the reference's one-result-per-record contract (decode.py:159-185)."""
         if self._sharded_search is not None:
             from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
 
@@ -199,12 +202,11 @@ class BeamSearchDecoder:
             out = beam_search.run_beam_search(self._params, self._hps,
                                               batch.as_arrays())
         results: List[DecodedResult] = []
-        seen: set = set()
+        real_mask = getattr(batch, "real_mask",
+                            [True] * len(batch.original_articles))
         for b in range(len(batch.original_articles)):
-            key = (batch.uuids[b], batch.original_articles[b])
-            if key in seen:
+            if not real_mask[b]:
                 continue
-            seen.add(key)
             n = int(out.length[b])
             output_ids = [int(t) for t in out.tokens[b][1:n]]  # strip START
             decoded_words = oov_lib.outputids2words(
