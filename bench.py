@@ -120,6 +120,23 @@ def supervise() -> None:
 # analytic FLOPs model (for MFU)
 # --------------------------------------------------------------------------
 
+def transformer_flops_per_step(hps) -> float:
+    """Analytic training FLOPs/step for the transformer family: per-layer
+    attention projections + score/value matmuls + FFN, plus the tied
+    [H, V] output projection; training = 3x forward."""
+    B, Te, Td = hps.batch_size, hps.max_enc_steps, hps.max_dec_steps
+    H, V = hps.hidden_dim, hps.vocab_size
+    F = hps.ffn_width
+    enc_layer = 4 * Te * H * H + 2 * Te * Te * H + 2 * Te * H * F
+    dec_layer = (4 * Td * H * H + 2 * Td * Td * H       # causal self-attn
+                 + 2 * Td * H * H + 2 * Te * H * H      # cross q,o + k,v
+                 + 2 * Td * Te * H                      # cross scores+ctx
+                 + 2 * Td * H * F)                      # ffn
+    macs = B * (hps.enc_layers * enc_layer + hps.dec_layers * dec_layer
+                + Td * H * V)
+    return float(3 * 2 * macs)
+
+
 def train_flops_per_step(hps) -> float:
     """Analytic training FLOPs/step for the pointer-generator.
 
@@ -182,12 +199,20 @@ def _device_info():
 def _preset_overrides() -> dict:
     """BENCH_PRESET=tiny shrinks the model for smoke runs (full-scale
     beam-search compiles take minutes on CPU); default is the reference
-    scale."""
+    scale.  BENCH_FAMILY=transformer benches the second model family
+    (BART-class; 6+6 layers at hidden_dim width)."""
+    out = {}
     if os.environ.get("BENCH_PRESET") == "tiny":
-        return dict(hidden_dim=16, emb_dim=8, vocab_size=200,
-                    max_enc_steps=32, max_dec_steps=8, beam_size=2,
-                    min_dec_steps=1, max_oov_buckets=8)
-    return {}
+        out.update(hidden_dim=16, emb_dim=8, vocab_size=200,
+                   max_enc_steps=32, max_dec_steps=8, beam_size=2,
+                   min_dec_steps=1, max_oov_buckets=8)
+    family = os.environ.get("BENCH_FAMILY", "")
+    if family:
+        out["model_family"] = family
+        if family == "transformer" and "hidden_dim" in out:
+            out["num_heads"] = 4  # tiny preset: 16/4 heads
+            out["enc_layers"] = out["dec_layers"] = 2
+    return out
 
 
 def bench_train() -> None:
@@ -232,7 +257,9 @@ def bench_train() -> None:
     step_time = dt / steps
     baseline = 13.5  # single-GPU K40m anchor, see module docstring
     dev, info = _device_info()
-    flops = train_flops_per_step(hps)
+    flops = (transformer_flops_per_step(hps)
+             if hps.model_family == "transformer"
+             else train_flops_per_step(hps))
     peak = peak_flops_for(dev)
     rec = {
         "metric": "train_samples_per_sec",
@@ -245,6 +272,7 @@ def bench_train() -> None:
                 if peak else None),
         "peak_tflops": (peak / 1e12 if peak else None),
         "loss": round(loss, 4),
+        "model_family": hps.model_family,
     }
     rec.update(info)
     print(json.dumps(rec))

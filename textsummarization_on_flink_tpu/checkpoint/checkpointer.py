@@ -63,6 +63,9 @@ def _flatten(tree: PyTree, prefix: str = "") -> Dict[str, np.ndarray]:
         elif hasattr(node, "_fields"):  # NamedTuple
             for k in node._fields:
                 rec(getattr(node, k), f"{path}/{k}" if path else str(k))
+        elif isinstance(node, (list, tuple)):  # e.g. transformer layer lists
+            for i, v in enumerate(node):
+                rec(v, f"{path}/{i}" if path else str(i))
         else:
             out[path] = np.asarray(node)
 
@@ -70,8 +73,19 @@ def _flatten(tree: PyTree, prefix: str = "") -> Dict[str, np.ndarray]:
     return out
 
 
+def _listify(node: Any) -> Any:
+    """Turn {'0': .., '1': ..} dicts (flattened lists) back into lists."""
+    if isinstance(node, dict):
+        node = {k: _listify(v) for k, v in node.items()}
+        if node and all(k.isdigit() for k in node) \
+                and sorted(int(k) for k in node) == list(range(len(node))):
+            return [node[str(i)] for i in range(len(node))]
+    return node
+
+
 def _unflatten_dicts(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
-    """Rebuild a pure nested-dict tree from slash-joined keys."""
+    """Rebuild a nested tree from slash-joined keys (lists restored from
+    their integer-key segments)."""
     root: Dict[str, Any] = {}
     for key, val in flat.items():
         parts = key.split("/")
@@ -79,7 +93,7 @@ def _unflatten_dicts(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
         for p in parts[:-1]:
             node = node.setdefault(p, {})
         node[parts[-1]] = val
-    return root
+    return _listify(root)
 
 
 def state_to_arrays(state: TrainState) -> Dict[str, np.ndarray]:
@@ -285,6 +299,11 @@ def convert_to_coverage_model(train_dir: str, hps: HParams,
             "re-converting would destroy trained coverage params "
             "(pass force=True to override)")
     state = arrays_to_state(load_arrays(path))
+    if "attention" not in (state.params.get("decoder") or {}):
+        raise ValueError(
+            "coverage conversion applies to the pointer_generator family "
+            "only — the transformer's coverage penalty has no parameters "
+            "to add, set --coverage directly")
     new_params = pg.add_coverage_params(state.params,
                                         jax.random.PRNGKey(seed))
     # fresh accumulator only for the new variable (others keep history)

@@ -75,6 +75,12 @@ class HParams:
     tp: int = 1  # tensor-parallel mesh axis size (output projection)
     sp: int = 1  # sequence/context-parallel mesh axis size
     model_family: str = "pointer_generator"  # or "transformer"
+    # transformer-family shape (BART-class encoder-decoder; hidden_dim is
+    # d_model, embeddings are tied, ffn_dim=0 means 4*hidden_dim)
+    enc_layers: int = 6
+    dec_layers: int = 6
+    num_heads: int = 8
+    ffn_dim: int = 0
     # metrics fetch cadence in steps (one blocking D2H sync per window);
     # 0 = auto: 1 under --debug, 10 otherwise
     metrics_every: int = 0
@@ -87,6 +93,11 @@ class HParams:
     @property
     def extended_vsize(self) -> int:
         return self.vocab_size + self.max_oov_buckets
+
+    @property
+    def ffn_width(self) -> int:
+        """Transformer FFN hidden width (ffn_dim, or 4*hidden_dim when 0)."""
+        return self.ffn_dim or 4 * self.hidden_dim
 
     def replace(self, **kw: Any) -> "HParams":
         return dataclasses.replace(self, **kw)
@@ -182,3 +193,15 @@ class HParams:
             raise ValueError("max_enc_steps/max_dec_steps must be >= 1")
         if self.min_dec_steps >= self.max_dec_steps:
             raise ValueError("min_dec_steps must be < max_dec_steps")
+        from textsummarization_on_flink_tpu.models import FAMILIES
+
+        if self.model_family not in FAMILIES:
+            raise ValueError(f"unknown model_family {self.model_family!r}; "
+                             f"expected one of {FAMILIES}")
+        if self.model_family == "transformer":
+            if self.hidden_dim % self.num_heads != 0:
+                raise ValueError(
+                    f"num_heads={self.num_heads} must divide "
+                    f"hidden_dim={self.hidden_dim}")
+            if self.enc_layers < 1 or self.dec_layers < 1:
+                raise ValueError("enc_layers/dec_layers must be >= 1")

@@ -31,12 +31,17 @@ cumulative-sum computation over the `beam*2*beam` sorted candidates (no
 data-dependent Python), and a whole batch of B articles is searched per
 dispatch via `vmap`.  OOV ids are mapped back to UNK before the embedding
 lookup inside the loop (beam_search.py:112).
+
+Model-family-agnostic: the search drives the (init_state, step) beam
+adapter of ``hps.model_family`` (models/__init__.get_family), carrying the
+model's decode state — LSTM cell + coverage, or a transformer KV cache —
+as an opaque pytree whose leaves lead with the beam axis.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple
+from typing import Any, Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +49,7 @@ import numpy as np
 
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.data.vocab import START_ID, STOP_ID, UNK_ID
-from textsummarization_on_flink_tpu.models import pointer_generator as pg
+from textsummarization_on_flink_tpu.models import get_family
 
 Array = jax.Array
 
@@ -65,9 +70,7 @@ class _BeamState(NamedTuple):
     t: Array  # scalar int32: decode step (reference's `steps`)
     tokens: Array  # [K, T+1]
     sum_lp: Array  # [K] total log prob of live hyps
-    cell_c: Array  # [K, H]
-    cell_h: Array  # [K, H]
-    coverage: Array  # [K, T_enc]
+    dec_state: Any  # model-family decode state; leaves lead with K
     attn_hist: Array  # [K, T, T_enc]
     pgen_hist: Array  # [K, T]
     n_res: Array  # scalar int32: filled result slots
@@ -78,35 +81,25 @@ class _BeamState(NamedTuple):
     res_pgen: Array  # [K+1, T]
 
 
-def _search_one(params, hps: HParams, enc_states, enc_feats, dec_c, dec_h,
+def _search_one(params, hps: HParams, init_state_fn, step_fn, enc_one,
                 enc_mask, ext_ids) -> BeamSearchOutput:
     """Beam search for ONE article (un-batched inputs; vmapped below).
 
-    enc_states/enc_feats: [T_enc, D]; dec_c/dec_h: [H];
-    enc_mask: [T_enc]; ext_ids: [T_enc] extended-vocab encoder ids.
+    enc_one: the family's per-article encoder view (pytree, no batch
+    axis); enc_mask: [T_enc]; ext_ids: [T_enc] extended-vocab ids.
+    init_state_fn/step_fn: the family's beam adapter (models/__init__).
     """
     K = hps.beam_size
     T = hps.max_dec_steps
-    T_enc = enc_states.shape[0]
-    H = dec_c.shape[0]
+    T_enc = enc_mask.shape[0]
     V = hps.vocab_size
     S = K * 2 * K  # candidate count per step
-
-    enc = pg.EncoderOutput(
-        enc_states=jnp.broadcast_to(enc_states[None], (K,) + enc_states.shape),
-        enc_features=jnp.broadcast_to(enc_feats[None], (K,) + enc_feats.shape),
-        dec_in_state=(jnp.broadcast_to(dec_c[None], (K, H)),
-                      jnp.broadcast_to(dec_h[None], (K, H))))
-    mask_k = jnp.broadcast_to(enc_mask[None], (K, T_enc))
-    ext_k = jnp.broadcast_to(ext_ids[None], (K, T_enc))
 
     init = _BeamState(
         t=jnp.zeros((), jnp.int32),
         tokens=jnp.full((K, T + 1), STOP_ID, jnp.int32).at[:, 0].set(START_ID),
         sum_lp=jnp.zeros((K,), jnp.float32),
-        cell_c=enc.dec_in_state[0],
-        cell_h=enc.dec_in_state[1],
-        coverage=jnp.zeros((K, T_enc), jnp.float32),
+        dec_state=init_state_fn(params, enc_one),
         attn_hist=jnp.zeros((K, T, T_enc), jnp.float32),
         pgen_hist=jnp.zeros((K, T), jnp.float32),
         n_res=jnp.zeros((), jnp.int32),
@@ -123,8 +116,8 @@ def _search_one(params, hps: HParams, enc_states, enc_feats, dec_c, dec_h,
     def body(s: _BeamState) -> _BeamState:
         latest = s.tokens[:, s.t]  # [K]
         latest = jnp.where(latest >= V, UNK_ID, latest)  # beam_search.py:112
-        step = pg.decode_onestep(params, hps, enc, mask_k, ext_k, latest,
-                                 (s.cell_c, s.cell_h), s.coverage)
+        step = step_fn(params, enc_one, enc_mask, ext_ids, s.t, latest,
+                       s.dec_state)
         # candidate pool: every live hyp x its 2K continuations
         cand_lp = s.sum_lp[:, None] + step.topk_log_probs  # [K, 2K]
         # step 0: all hyps identical -> expand only hyp 0 (beam_search.py:125)
@@ -174,9 +167,7 @@ def _search_one(params, hps: HParams, enc_states, enc_feats, dec_c, dec_h,
             t=s.t + 1,
             tokens=new_tokens,
             sum_lp=new_sum_lp,
-            cell_c=step.state[0][par],
-            cell_h=step.state[1][par],
-            coverage=step.coverage[par],
+            dec_state=jax.tree_util.tree_map(lambda x: x[par], step.state),
             attn_hist=new_attn,
             pgen_hist=new_pgen,
             n_res=s.n_res + jnp.sum(res_sel).astype(jnp.int32),
@@ -219,11 +210,11 @@ def _search_one(params, hps: HParams, enc_states, enc_feats, dec_c, dec_h,
 def _search_batch(params, hps: HParams, arrays: Dict[str, Array],
                   ) -> BeamSearchOutput:
     """Encode a batch of B articles once, then vmap the per-article search."""
-    enc = pg.run_encoder(params, hps, arrays)
-    fn = functools.partial(_search_one, params, hps)
-    return jax.vmap(fn)(enc.enc_states, enc.enc_features,
-                        enc.dec_in_state[0], enc.dec_in_state[1],
-                        arrays["enc_padding_mask"],
+    family = get_family(hps.model_family)
+    enc_view = family.beam_encode(params, hps, arrays)
+    init_state_fn, step_fn = family.beam_adapter(hps)
+    fn = functools.partial(_search_one, params, hps, init_state_fn, step_fn)
+    return jax.vmap(fn)(enc_view, arrays["enc_padding_mask"],
                         arrays["enc_batch_extend_vocab"])
 
 

@@ -202,10 +202,8 @@ class BeamSearchDecoder:
             out = beam_search.run_beam_search(self._params, self._hps,
                                               batch.as_arrays())
         results: List[DecodedResult] = []
-        real_mask = getattr(batch, "real_mask",
-                            [True] * len(batch.original_articles))
         for b in range(len(batch.original_articles)):
-            if not real_mask[b]:
+            if not batch.real_mask[b]:
                 continue
             n = int(out.length[b])
             output_ids = [int(t) for t in out.tokens[b][1:n]]  # strip START

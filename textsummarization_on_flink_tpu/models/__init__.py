@@ -1,1 +1,34 @@
-"""Model families: pointer-generator (LSTM seq2seq) and transformer."""
+"""Model families: pointer-generator (LSTM seq2seq) and transformer.
+
+Every family is a module exposing the same functional surface, so the
+Trainer/Evaluator, beam search, checkpointing, and serving stack are
+family-agnostic:
+
+  init_params(hps, vsize, key) -> Params
+  forward_train(params, hps, arrays) -> TrainOutput
+  beam_encode(params, hps, arrays) -> per-batch encoder view (pytree)
+  beam_adapter(hps) -> (init_state, step) beam-search closures
+
+Select with ``hps.model_family`` (the reference has a single hardcoded
+model, run_summarization.py:376; the family seam is a rebuild addition
+that the BASELINE.md stretch config requires).
+"""
+
+from __future__ import annotations
+
+from types import ModuleType
+
+FAMILIES = ("pointer_generator", "transformer")
+
+
+def get_family(name: str) -> ModuleType:
+    """Resolve a model-family name to its module (lazy imports keep
+    startup light and avoid cycles)."""
+    if name == "pointer_generator":
+        from textsummarization_on_flink_tpu.models import pointer_generator
+        return pointer_generator
+    if name == "transformer":
+        from textsummarization_on_flink_tpu.models import transformer
+        return transformer
+    raise ValueError(
+        f"unknown model_family {name!r}; expected one of {FAMILIES}")

@@ -329,3 +329,65 @@ def decode_onestep(params: Params, hps: HParams, enc: EncoderOutput,
                             topk_log_probs=jnp.log(topk_probs),
                             state=new_state, attn_dist=attn_dist, p_gen=p_gen,
                             coverage=cov)
+
+
+# --------------------------------------------------------------------------
+# Beam-search adapter protocol (shared by all model families)
+# --------------------------------------------------------------------------
+
+class BeamStepOut(NamedTuple):
+    """Model-agnostic one-step beam output (decode/beam_search.py).
+    ``state`` is an opaque pytree whose every leaf has leading beam axis K,
+    so the search can gather surviving hypotheses with one tree_map."""
+
+    topk_ids: Array  # [K, 2*beam]
+    topk_log_probs: Array  # [K, 2*beam]
+    attn_dist: Array  # [K, T_enc]
+    p_gen: Array  # [K]
+    state: Any
+
+
+def beam_encode(params: Params, hps: HParams, arrays: Dict[str, Array],
+                ) -> EncoderOutput:
+    """Batched encoder view for beam search (leaves lead with B; the
+    search vmaps per article)."""
+    return run_encoder(params, hps, arrays)
+
+
+def beam_adapter(hps: HParams):
+    """(init_state, step) closures implementing the beam protocol for the
+    LSTM pointer-generator.  State = decoder cell (c, h) + coverage."""
+    K = hps.beam_size
+
+    def init_state(params: Params, enc_one: EncoderOutput):
+        del params
+        H = enc_one.dec_in_state[0].shape[-1]
+        T_enc = enc_one.enc_states.shape[0]
+        return {
+            "cell_c": jnp.broadcast_to(enc_one.dec_in_state[0][None], (K, H)),
+            "cell_h": jnp.broadcast_to(enc_one.dec_in_state[1][None], (K, H)),
+            "coverage": jnp.zeros((K, T_enc), jnp.float32),
+        }
+
+    def step(params: Params, enc_one: EncoderOutput, enc_mask: Array,
+             ext_ids: Array, t: Array, latest: Array, state) -> BeamStepOut:
+        del t  # the LSTM state carries all positional context
+        T_enc = enc_one.enc_states.shape[0]
+        enc = EncoderOutput(
+            enc_states=jnp.broadcast_to(
+                enc_one.enc_states[None], (K,) + enc_one.enc_states.shape),
+            enc_features=jnp.broadcast_to(
+                enc_one.enc_features[None], (K,) + enc_one.enc_features.shape),
+            dec_in_state=(state["cell_c"], state["cell_h"]))
+        mask_k = jnp.broadcast_to(enc_mask[None], (K, T_enc))
+        ext_k = jnp.broadcast_to(ext_ids[None], (K, T_enc))
+        out = decode_onestep(params, hps, enc, mask_k, ext_k, latest,
+                             (state["cell_c"], state["cell_h"]),
+                             state["coverage"])
+        return BeamStepOut(
+            topk_ids=out.topk_ids, topk_log_probs=out.topk_log_probs,
+            attn_dist=out.attn_dist, p_gen=out.p_gen,
+            state={"cell_c": out.state[0], "cell_h": out.state[1],
+                   "coverage": out.coverage})
+
+    return init_state, step

@@ -1,0 +1,388 @@
+"""Transformer (BART-class) summarization model family, TPU-native.
+
+The reference repository's only model is the LSTM pointer-generator
+(/root/reference/src/main/python/pointer-generator/model.py); this module
+is the framework's second model family — the BASELINE.md stretch row
+("BART-base behind the same Estimator/Model API") — sharing every
+surrounding subsystem: the same ``HParams``, the same ``Batch`` arrays,
+the same ``TrainOutput`` contract consumed by the Trainer/Evaluator, the
+same on-device beam search (via the beam-adapter protocol in
+decode/beam_search.py), the same checkpointing and serving stack.
+
+Architecture (TPU-first choices, not a port of any torch code):
+
+  * pre-LN encoder-decoder with learned positional embeddings and a tied
+    input/output embedding ([V, H] — the single biggest matrix, sharded
+    over the tp mesh axis exactly like the pointer-generator's
+    output_projection);
+  * teacher-forced training is fully parallel over decode steps (one
+    batched matmul chain — no scan), which is the transformer's
+    structural advantage over the reference's 100-step unrolled LSTM
+    graph (model.py:214);
+  * the pointer/copy mechanism is preserved: the FINAL decoder layer's
+    cross-attention (averaged over heads) is the copy distribution,
+    ``p_gen = sigmoid(linear([h, cross_ctx]))`` mixes it with the vocab
+    softmax, and training computes the gold mixture probability from raw
+    logits (same math as ops/losses.gold_mixture_prob, deliberately
+    inlined in log space so neither the [B, T, V] softmax nor the
+    extended-vocab distribution is ever materialized);
+  * coverage (``hps.coverage``) penalizes repeated cross-attention via
+    the closed-form exclusive-cumsum coverage loss
+    (ops/losses.coverage_loss).  Unlike the LSTM family, coverage does
+    NOT feed back into attention energies — that mechanism is specific
+    to the reference's additive attention (attention_decoder.py:113-123);
+    here coverage is purely the training penalty;
+  * incremental decoding uses a static-shape KV cache ([K, L, T, nh, hd]
+    with a position mask) so the whole beam search stays inside one
+    jitted while_loop;
+  * attention logits, softmax, and layernorm run in f32; matmuls follow
+    ``hps.compute_dtype`` (bf16 on the MXU).
+
+No dropout: the reference trains without regularization
+(run_summarization.py:62-74 has no dropout flag) and determinism keeps
+step-parity tests exact.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.models import pointer_generator as pg
+from textsummarization_on_flink_tpu.ops import losses as loss_ops
+
+Array = jax.Array
+Params = Dict[str, Any]
+
+TrainOutput = pg.TrainOutput  # same contract for Trainer/Evaluator
+
+
+# --------------------------------------------------------------------------
+# Shapes / init
+# --------------------------------------------------------------------------
+
+def _ffn_dim(hps: HParams) -> int:
+    return hps.ffn_width
+
+
+def _head_dim(hps: HParams) -> int:
+    return hps.hidden_dim // hps.num_heads
+
+
+def _init_attn(key: Array, H: int) -> Dict[str, Array]:
+    ks = jax.random.split(key, 4)
+    return {
+        "wq": pg._glorot(ks[0], (H, H)),
+        "wk": pg._glorot(ks[1], (H, H)),
+        "wv": pg._glorot(ks[2], (H, H)),
+        "wo": pg._glorot(ks[3], (H, H)),
+    }
+
+
+def _init_ln(H: int) -> Dict[str, Array]:
+    return {"scale": jnp.ones((H,), jnp.float32),
+            "bias": jnp.zeros((H,), jnp.float32)}
+
+
+def _init_ffn(key: Array, H: int, F: int) -> Dict[str, Array]:
+    k1, k2 = jax.random.split(key)
+    return {"w1": pg._glorot(k1, (H, F)), "b1": jnp.zeros((F,), jnp.float32),
+            "w2": pg._glorot(k2, (F, H)), "b2": jnp.zeros((H,), jnp.float32)}
+
+
+def init_params(hps: HParams, vsize: int, key: Array) -> Params:
+    """Parameter pytree.  Top-level ``embedding`` is [V, H] (same name and
+    vocab-leading layout as the pointer-generator so mesh tp-sharding and
+    divisibility validation apply unchanged)."""
+    H, F = hps.hidden_dim, _ffn_dim(hps)
+    n_keys = 3 + 2 * hps.enc_layers + 3 * hps.dec_layers + 1
+    keys = iter(jax.random.split(key, n_keys))
+
+    enc_layers = []
+    for _ in range(hps.enc_layers):
+        enc_layers.append({
+            "ln1": _init_ln(H), "self_attn": _init_attn(next(keys), H),
+            "ln2": _init_ln(H), "ffn": _init_ffn(next(keys), H, F),
+        })
+    dec_layers = []
+    for _ in range(hps.dec_layers):
+        dec_layers.append({
+            "ln1": _init_ln(H), "self_attn": _init_attn(next(keys), H),
+            "ln_cross": _init_ln(H), "cross_attn": _init_attn(next(keys), H),
+            "ln2": _init_ln(H), "ffn": _init_ffn(next(keys), H, F),
+        })
+    return {
+        "embedding": pg._trunc_normal(next(keys), (vsize, H), 0.02),
+        "pos_enc": pg._trunc_normal(next(keys), (hps.max_enc_steps, H), 0.02),
+        "pos_dec": pg._trunc_normal(next(keys), (hps.max_dec_steps + 1, H),
+                                    0.02),
+        "encoder": {"layers": enc_layers, "ln_out": _init_ln(H)},
+        "decoder": {"layers": dec_layers, "ln_out": _init_ln(H)},
+        "pgen_linear": {"kernel": pg._glorot(next(keys), (2 * H, 1)),
+                        "bias": jnp.zeros((1,), jnp.float32)},
+        "out_bias": jnp.zeros((vsize,), jnp.float32),
+    }
+
+
+# --------------------------------------------------------------------------
+# Core blocks
+# --------------------------------------------------------------------------
+
+def _ln(p: Dict[str, Array], x: Array) -> Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-6)
+            * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _split_heads(hps: HParams, x: Array) -> Array:
+    """[..., H] -> [..., nh, hd]"""
+    return x.reshape(x.shape[:-1] + (hps.num_heads, _head_dim(hps)))
+
+
+def _merge_heads(x: Array) -> Array:
+    return x.reshape(x.shape[:-2] + (x.shape[-2] * x.shape[-1],))
+
+
+def _mha(hps: HParams, p: Dict[str, Array], q_in: Array, kv_in: Array,
+         mask: Array) -> Tuple[Array, Array]:
+    """Multi-head attention.
+
+    q_in: [..., Tq, H]; kv_in: [..., Tk, H]; mask: broadcastable to
+    [..., Tq, Tk] (1 = attend).  Returns (output [..., Tq, H],
+    head-averaged probabilities [..., Tq, Tk] in f32).
+    """
+    q = _split_heads(hps, q_in @ p["wq"])  # [..., Tq, nh, hd]
+    k = _split_heads(hps, kv_in @ p["wk"])
+    v = _split_heads(hps, kv_in @ p["wv"])
+    scale = _head_dim(hps) ** -0.5
+    logits = jnp.einsum("...qnd,...knd->...nqk", q, k).astype(jnp.float32)
+    logits = logits * scale
+    neg = jnp.asarray(-1e30, jnp.float32)
+    logits = jnp.where(mask[..., None, :, :] > 0, logits, neg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # a fully-masked query row gives a uniform softmax over -1e30 logits;
+    # zero it so padding queries emit exact zeros (matches the clamped
+    # masked_softmax semantics in ops/attention.py)
+    any_key = jnp.sum(mask[..., None, :, :], axis=-1, keepdims=True) > 0
+    probs = jnp.where(any_key, probs, 0.0)
+    ctx = jnp.einsum("...nqk,...knd->...qnd", probs.astype(v.dtype), v)
+    out = _merge_heads(ctx) @ p["wo"]
+    return out, jnp.mean(probs, axis=-3)  # head-avg [..., Tq, Tk]
+
+
+def _ffn_block(p: Dict[str, Array], x: Array) -> Array:
+    return jax.nn.gelu(x @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+
+
+def _encoder_stack(params: Params, hps: HParams, x: Array,
+                   enc_mask: Array) -> Array:
+    """x: [B, T_enc, H]; enc_mask: [B, T_enc] -> [B, T_enc, H] (f32)."""
+    attn_mask = enc_mask[:, None, :]  # every query sees all real keys
+    for layer in params["encoder"]["layers"]:
+        h = _ln(layer["ln1"], x)
+        a, _ = _mha(hps, layer["self_attn"], h, h, attn_mask)
+        x = x + a
+        x = x + _ffn_block(layer["ffn"], _ln(layer["ln2"], x))
+    return _ln(params["encoder"]["ln_out"], x).astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# Training forward (fully parallel over decode steps)
+# --------------------------------------------------------------------------
+
+class TransformerEncView(NamedTuple):
+    """Per-batch encoder view for decoding: final encoder states plus the
+    per-layer cross-attention K/V, precomputed once per article."""
+
+    enc_out: Array  # [B, T_enc, H] f32
+    cross_k: Array  # [B, L, T_enc, nh, hd]
+    cross_v: Array  # [B, L, T_enc, nh, hd]
+
+
+def _embed_enc(params: Params, hps: HParams, enc_batch: Array) -> Array:
+    T = enc_batch.shape[-1]
+    x = params["embedding"][enc_batch] + params["pos_enc"][:T]
+    return pg._cast(hps, x)
+
+
+def _embed_dec(params: Params, hps: HParams, tokens: Array,
+               positions: Array) -> Array:
+    x = params["embedding"][tokens] + params["pos_dec"][positions]
+    return pg._cast(hps, x)
+
+
+def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
+                  ) -> TrainOutput:
+    """Teacher-forced training/eval forward pass -> TrainOutput.
+
+    Same loss semantics as the pointer-generator family: masked-average
+    pointer NLL + optional coverage penalty on the copy attention.  The
+    gold mixture probability is computed from raw logits (the same math
+    as ops/losses.gold_mixture_prob, inlined in log space so the
+    [B, T, V] softmax is never materialized)."""
+    enc_mask = arrays["enc_padding_mask"]  # [B, T_enc]
+    dec_mask = arrays["dec_padding_mask"]  # [B, T_dec]
+    T_dec = arrays["dec_batch"].shape[1]
+
+    x = _embed_enc(params, hps, arrays["enc_batch"])
+    enc_out = _encoder_stack(params, hps, x, enc_mask)
+    enc_out_c = pg._cast(hps, enc_out)
+
+    y = _embed_dec(params, hps, arrays["dec_batch"], jnp.arange(T_dec))
+    causal = jnp.tril(jnp.ones((T_dec, T_dec), jnp.float32))[None]
+    cross_mask = enc_mask[:, None, :]  # [B, 1, T_enc]
+    attn_dist = None
+    for layer in params["decoder"]["layers"]:
+        hn = _ln(layer["ln1"], y)
+        a, _ = _mha(hps, layer["self_attn"], hn, hn, causal)
+        y = y + a
+        c, probs = _mha(hps, layer["cross_attn"], _ln(layer["ln_cross"], y),
+                        enc_out_c, cross_mask)
+        y = y + c
+        y = y + _ffn_block(layer["ffn"], _ln(layer["ln2"], y))
+        attn_dist = probs  # final layer's head-averaged copy distribution
+        cross_ctx = c
+    h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
+
+    logits = (h @ params["embedding"].T.astype(h.dtype)
+              + params["out_bias"])  # [B, T_dec, V] tied projection
+    p_gens = jax.nn.sigmoid(
+        jnp.concatenate([h, cross_ctx.astype(jnp.float32)], axis=-1)
+        @ params["pgen_linear"]["kernel"]
+        + params["pgen_linear"]["bias"])[..., 0]  # [B, T_dec]
+
+    targets = arrays["target_batch"]
+    V = logits.shape[-1]
+    if hps.pointer_gen:
+        # gold prob without materializing softmax over [B, T, V]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        in_vocab = targets < V
+        safe_t = jnp.where(in_vocab, targets, 0)
+        gen_logp = jnp.take_along_axis(
+            logits, safe_t[..., None], axis=-1)[..., 0] - lse
+        gen_prob = jnp.where(in_vocab, jnp.exp(gen_logp), 0.0)
+        copy_prob = jnp.sum(
+            attn_dist * (arrays["enc_batch_extend_vocab"][:, None, :]
+                         == targets[..., None]), axis=-1)
+        gold = p_gens * gen_prob + (1.0 - p_gens) * copy_prob
+        loss = loss_ops.mask_and_avg(-jnp.log(gold + 1e-10), dec_mask)
+    else:
+        loss = loss_ops.softmax_cross_entropy_baseline(
+            logits, targets, dec_mask)
+    if hps.coverage:
+        cov_loss = loss_ops.coverage_loss(attn_dist, dec_mask)
+    else:
+        cov_loss = jnp.zeros(())
+    total = loss + hps.cov_loss_wt * cov_loss
+    return TrainOutput(loss=loss, coverage_loss=cov_loss, total_loss=total,
+                       attn_dists=attn_dist, p_gens=p_gens)
+
+
+# --------------------------------------------------------------------------
+# Decoding (KV-cache incremental step + beam adapter)
+# --------------------------------------------------------------------------
+
+def beam_encode(params: Params, hps: HParams, arrays: Dict[str, Array],
+                ) -> TransformerEncView:
+    """Encode a batch once and precompute per-layer cross-attention K/V
+    (leaves have a leading batch axis; vmapped per-article downstream)."""
+    x = _embed_enc(params, hps, arrays["enc_batch"])
+    enc_out = _encoder_stack(params, hps, x, arrays["enc_padding_mask"])
+    enc_c = pg._cast(hps, enc_out)
+    ks, vs = [], []
+    for layer in params["decoder"]["layers"]:
+        p = layer["cross_attn"]
+        ks.append(_split_heads(hps, enc_c @ p["wk"]))
+        vs.append(_split_heads(hps, enc_c @ p["wv"]))
+    return TransformerEncView(enc_out=enc_out,
+                              cross_k=jnp.stack(ks, axis=1),
+                              cross_v=jnp.stack(vs, axis=1))
+
+
+BeamStepOut = pg.BeamStepOut  # shared beam protocol output type
+
+
+def beam_adapter(hps: HParams):
+    """Beam-search protocol: (init_state, step) closures over params.
+
+    State leaves all carry a leading beam axis K so the search can gather
+    surviving hypotheses with one tree_map.  The KV cache is static-shape
+    [K, L, T_dec+1, nh, hd]; position validity comes from the step index.
+    """
+    K = hps.beam_size
+    L = hps.dec_layers
+    nh, hd = hps.num_heads, _head_dim(hps)
+    T = hps.max_dec_steps + 1
+
+    def init_state(params: Params, enc_one: TransformerEncView):
+        del params, enc_one
+        return {
+            "cache_k": jnp.zeros((K, L, T, nh, hd), jnp.float32),
+            "cache_v": jnp.zeros((K, L, T, nh, hd), jnp.float32),
+        }
+
+    def step(params: Params, enc_one: TransformerEncView, enc_mask: Array,
+             ext_ids: Array, t: Array, latest: Array, state):
+        """enc_one leaves are per-article (no batch axis); latest: [K]."""
+        y = _embed_dec(params, hps, latest, t)  # [K, H]
+        pos_ok = (jnp.arange(T) <= t).astype(jnp.float32)  # [T]
+        cache_k, cache_v = state["cache_k"], state["cache_v"]
+        attn_dist = None
+        for li, layer in enumerate(params["decoder"]["layers"]):
+            p = layer["self_attn"]
+            h_norm = _ln(layer["ln1"], y)
+            q = _split_heads(hps, h_norm @ p["wq"])  # [K, nh, hd]
+            k_new = _split_heads(hps, h_norm @ p["wk"])
+            v_new = _split_heads(hps, h_norm @ p["wv"])
+            cache_k = cache_k.at[:, li, t].set(k_new.astype(jnp.float32))
+            cache_v = cache_v.at[:, li, t].set(v_new.astype(jnp.float32))
+            kk = cache_k[:, li]  # [K, T, nh, hd]
+            vv = cache_v[:, li]
+            logits = jnp.einsum("knd,ktnd->knt", q.astype(jnp.float32), kk)
+            logits = logits * (hd ** -0.5)
+            logits = jnp.where(pos_ok[None, None, :] > 0, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            ctx = jnp.einsum("knt,ktnd->knd", probs, vv)
+            y = y + _merge_heads(ctx).astype(y.dtype) @ p["wo"]
+            # cross attention against the precomputed per-layer K/V
+            cp = layer["cross_attn"]
+            qc = _split_heads(hps, _ln(layer["ln_cross"], y) @ cp["wq"])
+            ck = enc_one.cross_k[li]  # [T_enc, nh, hd]
+            cv = enc_one.cross_v[li]
+            clogits = jnp.einsum("knd,tnd->knt", qc.astype(jnp.float32),
+                                 ck.astype(jnp.float32)) * (hd ** -0.5)
+            clogits = jnp.where(enc_mask[None, None, :] > 0, clogits, -1e30)
+            cprobs = jax.nn.softmax(clogits, axis=-1)
+            any_key = jnp.sum(enc_mask) > 0
+            cprobs = jnp.where(any_key, cprobs, 0.0)
+            cctx = jnp.einsum("knt,tnd->knd", cprobs, cv.astype(jnp.float32))
+            cross_out = _merge_heads(cctx).astype(y.dtype) @ cp["wo"]
+            y = y + cross_out
+            y = y + _ffn_block(layer["ffn"], _ln(layer["ln2"], y))
+            attn_dist = jnp.mean(cprobs, axis=1)  # [K, T_enc] head-avg
+            cross_ctx = cross_out
+        h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
+        vocab_scores = h @ params["embedding"].T + params["out_bias"]
+        vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
+        p_gen = jax.nn.sigmoid(
+            jnp.concatenate([h, cross_ctx.astype(jnp.float32)], axis=-1)
+            @ params["pgen_linear"]["kernel"]
+            + params["pgen_linear"]["bias"])[:, 0]
+        if hps.pointer_gen:
+            ext_k = jnp.broadcast_to(ext_ids[None], (K,) + ext_ids.shape)
+            final_dist = pg.final_distribution(hps, vocab_dist, attn_dist,
+                                               p_gen, ext_k)
+        else:
+            final_dist = vocab_dist
+        topk_probs, topk_ids = jax.lax.top_k(final_dist, 2 * hps.beam_size)
+        return BeamStepOut(topk_ids=topk_ids,
+                           topk_log_probs=jnp.log(topk_probs + 1e-10),
+                           attn_dist=attn_dist, p_gen=p_gen,
+                           state={"cache_k": cache_k, "cache_v": cache_v})
+
+    return init_state, step

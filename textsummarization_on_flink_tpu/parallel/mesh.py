@@ -101,21 +101,35 @@ def make_mesh(hps: HParams, devices: Optional[Sequence[jax.Device]] = None,
 # --------------------------------------------------------------------------
 
 def param_pspecs(params: PyTree) -> PyTree:
-    """PartitionSpec tree for the pointer-generator parameter pytree.
+    """PartitionSpec tree for a model-family parameter pytree.
 
-    Vocab-dimension tensors shard over `tp`; everything else (LSTM kernels,
-    attention, reduce — all small: ~[384,1024] at the default config) is
-    replicated, which keeps their per-step all-reduce traffic at zero.
+    Pointer-generator: vocab-dimension tensors shard over `tp`; everything
+    else (LSTM kernels, attention, reduce — all small: ~[384,1024] at the
+    default config) is replicated, which keeps their per-step all-reduce
+    traffic at zero.
+
+    Transformer: the tied [V, H] embedding and [V] out_bias shard over
+    vocab; attention wq/wk/wv and ffn w1 column-shard (heads/ffn over tp),
+    wo and ffn w2 row-shard — the Megatron layout, so each attention/FFN
+    block needs exactly one all-reduce on its output.
     """
 
     def spec_for(path: Tuple[Any, ...], leaf: Any) -> P:
         keys = tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
         if "embedding" in keys:
-            return P("tp", None)  # [V, E] row-sharded over vocab
+            return P("tp", None)  # [V, E|H] row-sharded over vocab
         if "output_projection" in keys:
             if keys[-1] == "w":
                 return P(None, "tp")  # [H, V] column-sharded over vocab
             return P("tp")  # bias v: [V]
+        if keys[-1] == "out_bias":
+            return P("tp")  # transformer tied-projection bias [V]
+        if keys[-1] in ("wq", "wk", "wv", "w1"):
+            return P(None, "tp")  # heads / ffn hidden over tp
+        if keys[-1] in ("wo", "w2"):
+            return P("tp", None)  # row-parallel back to H
+        if keys[-1] == "b1":
+            return P("tp")  # ffn hidden bias [F]
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
@@ -248,6 +262,14 @@ def validate_divisibility(hps: HParams, params: Optional[PyTree] = None,
     if hps.sp > 1 and hps.max_enc_steps % hps.sp != 0:
         raise ValueError(f"sequence-parallel axis sp={hps.sp} must divide "
                          f"max_enc_steps={hps.max_enc_steps}")
+    if hps.tp > 1 and hps.model_family == "transformer":
+        if hps.num_heads % hps.tp != 0:
+            raise ValueError(
+                f"tensor-parallel axis tp={hps.tp} must divide "
+                f"num_heads={hps.num_heads} (Megatron head sharding)")
+        if hps.ffn_width % hps.tp != 0:
+            raise ValueError(f"tensor-parallel axis tp={hps.tp} must divide "
+                             f"ffn_dim={hps.ffn_width}")
 
 
 def make_sharded_beam_search(plan: MeshPlan,

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from textsummarization_on_flink_tpu.config import HParams
-from textsummarization_on_flink_tpu.models import pointer_generator as pg
+from textsummarization_on_flink_tpu.models import get_family
 from textsummarization_on_flink_tpu.train import optim
 
 log = logging.getLogger(__name__)
@@ -54,7 +54,7 @@ class StepMetrics(NamedTuple):
 def init_train_state(hps: HParams, vsize: int, seed: Optional[int] = None,
                      params: Optional[PyTree] = None) -> TrainState:
     if params is None:
-        params = pg.init_params(
+        params = get_family(hps.model_family).init_params(
             hps, vsize, jax.random.PRNGKey(seed if seed is not None else hps.seed))
     return TrainState(params=params,
                       opt_state=optim.adagrad_init(params, hps.adagrad_init_acc),
@@ -65,9 +65,11 @@ def make_train_step(hps: HParams) -> Callable[[TrainState, Dict[str, Array]],
                                               Tuple[TrainState, StepMetrics]]:
     """Build the pure train-step function (jit it, or pjit via parallel/)."""
 
+    family = get_family(hps.model_family)
+
     def train_step(state: TrainState, arrays: Dict[str, Array]):
         def loss_fn(params):
-            out = pg.forward_train(params, hps, arrays)
+            out = family.forward_train(params, hps, arrays)
             # minimize total_loss when coverage is on (model.py:291)
             objective = out.total_loss if hps.coverage else out.loss
             return objective, out
@@ -86,8 +88,10 @@ def make_train_step(hps: HParams) -> Callable[[TrainState, Dict[str, Array]],
 
 
 def make_eval_step(hps: HParams):
+    family = get_family(hps.model_family)
+
     def eval_step(params: PyTree, arrays: Dict[str, Array]) -> StepMetrics:
-        out = pg.forward_train(params, hps, arrays)
+        out = family.forward_train(params, hps, arrays)
         return StepMetrics(loss=out.loss, coverage_loss=out.coverage_loss,
                            total_loss=out.total_loss,
                            global_norm=jnp.zeros(()))
