@@ -172,6 +172,31 @@ class TestEvaluator:
         ev.run(state.params, step=2)
         assert len(saved) == 1
 
+    def test_best_model_updates_per_eval_iteration(self, tmp_path):
+        """The best check runs after EVERY eval batch (the reference saves
+        inside its loop, run_summarization.py:281-292), so improving
+        losses within one run() produce multiple saves."""
+        from textsummarization_on_flink_tpu.train.trainer import StepMetrics
+
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="t2")
+        vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+        batch = make_batch(hps, vocab)
+        saved = []
+        ev = Evaluator(hps, vocab.size(), FixedBatcher(batch, 3),
+                       best_saver=lambda p, l, s: saved.append(l))
+        losses = iter([5.0, 4.0, 3.0])  # strictly improving per batch
+
+        def fake_eval(params, arrays):
+            v = jnp.asarray(next(losses))
+            return StepMetrics(loss=v, coverage_loss=jnp.zeros(()),
+                               total_loss=v, global_norm=jnp.zeros(()))
+
+        ev._eval_fn = fake_eval
+        ev.run(object(), step=1)
+        # running avg: 5.0 -> 4.99 -> 4.9701, each a new best
+        assert len(saved) == 3
+        assert saved == sorted(saved, reverse=True)
+
 
 class TestDebugAndMultihostHelpers:
     def test_apply_debug_mode_toggles_jax_debug_nans(self):

@@ -123,6 +123,23 @@ def test_kv_cache_matches_teacher_forcing(setup):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_remat_gradient_parity(setup):
+    """--remat recomputes layer activations in backward; gradients must
+    match the stored-activation path (up to FP reassociation)."""
+    hps, vocab, batch, state = setup
+    arrays = batch.as_arrays()
+    g0 = jax.grad(
+        lambda p: tfm.forward_train(p, hps, arrays).total_loss)(state.params)
+    g1 = jax.grad(
+        lambda p: tfm.forward_train(p, hps.replace(remat=True),
+                                    arrays).total_loss)(state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        a, b = np.asarray(a), np.asarray(b)
+        scale = np.max(np.abs(a)) + 1e-12
+        assert np.max(np.abs(a - b)) / scale < 1e-5
+
+
 def test_beam_search_generic_driver(setup):
     hps, vocab, batch, state = setup
     enc_only = {k: v for k, v in batch.as_arrays().items()

@@ -88,6 +88,10 @@ class HParams:
     # at the same step on every host); 0 on a multi-host run falls back
     # to reinterpreting the 60s save_model_secs as a step count, loudly
     checkpoint_steps: int = 0
+    # rematerialize transformer layers in backward (jax.checkpoint):
+    # trades ~1/3 more FLOPs for O(layers) less activation HBM — for the
+    # long-context configs (enc 800+) where activations dominate
+    remat: bool = False
 
     # -- derived --
     @property

@@ -176,14 +176,17 @@ def encode(params: Params, hps: HParams, enc_batch: Array, enc_lens: Array,
 
 def _decoder_core(params: Params, hps: HParams, enc: EncoderOutput,
                   enc_padding_mask: Array, state: Tuple[Array, Array],
-                  context: Array, coverage: Array, inp_emb: Array,
+                  context: Array, coverage: Array, x: Array,
                   ) -> Dict[str, Array]:
     """One train-mode decoder step (attention_decoder.py:141-174):
-    merge input+context -> cell -> attention (updates coverage) -> p_gen
-    -> output projection input.  coverage always flows; with coverage off
-    it is simply unused by the attention energies."""
+    merged input+context `x` -> cell -> attention (updates coverage) ->
+    p_gen -> output projection input.  coverage always flows; with
+    coverage off it is simply unused by the attention energies.
+
+    `x` is the input_linear output; forward_train hoists its embedding
+    half out of the scan (one [B, T, E] @ [E, E] matmul) and adds the
+    context half per step."""
     dp = params["decoder"]
-    x = _linear(dp["input_linear"], inp_emb, context)
     cell_out, new_state = lstm_ops.lstm_cell(dp["cell"], x, state)
     new_context, attn_dist, new_cov = attn_ops.attend(
         dp["attention"], enc.enc_states, enc.enc_features, enc_padding_mask,
@@ -210,12 +213,19 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
     emb_dec = params["embedding"][arrays["dec_batch"]]  # [B, T_dec, E]
     w = params["output_projection"]["w"]
     v = params["output_projection"]["v"]
+    # hoist the embedding half of input_linear out of the scan (one big
+    # MXU matmul); the context half is added per step in-scan
+    ip = params["decoder"]["input_linear"]
+    E = emb_dec.shape[-1]
+    emb_proj = emb_dec @ ip["kernel"][:E] + ip["bias"]  # [B, T_dec, E]
+    k_ctx = ip["kernel"][E:]
 
     def step(carry, xs):
         state, context, coverage = carry
-        inp_emb, target, ext_ids_unused = xs
+        emb_proj_t, target, ext_ids_unused = xs
+        x = emb_proj_t + context @ k_ctx
         res = _decoder_core(params, hps, enc, arrays["enc_padding_mask"],
-                            state, context, coverage, inp_emb)
+                            state, context, coverage, x)
         vocab_scores = res["output"] @ w + v  # [B, V]
         vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
         if hps.pointer_gen:
@@ -234,7 +244,7 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
     D = enc.enc_states.shape[-1]
     init = (enc.dec_in_state, jnp.zeros((B, D), jnp.float32),
             jnp.zeros((B, T_enc), jnp.float32))
-    xs = (jnp.swapaxes(emb_dec, 0, 1),
+    xs = (jnp.swapaxes(emb_proj, 0, 1),
           jnp.swapaxes(arrays["target_batch"], 0, 1),
           jnp.swapaxes(arrays["target_batch"], 0, 1))
     _, (nlls, covlosses, attn_dists, p_gens) = jax.lax.scan(step, init, xs)

@@ -64,10 +64,6 @@ TrainOutput = pg.TrainOutput  # same contract for Trainer/Evaluator
 # Shapes / init
 # --------------------------------------------------------------------------
 
-def _ffn_dim(hps: HParams) -> int:
-    return hps.ffn_width
-
-
 def _head_dim(hps: HParams) -> int:
     return hps.hidden_dim // hps.num_heads
 
@@ -97,7 +93,7 @@ def init_params(hps: HParams, vsize: int, key: Array) -> Params:
     """Parameter pytree.  Top-level ``embedding`` is [V, H] (same name and
     vocab-leading layout as the pointer-generator so mesh tp-sharding and
     divisibility validation apply unchanged)."""
-    H, F = hps.hidden_dim, _ffn_dim(hps)
+    H, F = hps.hidden_dim, hps.ffn_width
     n_keys = 3 + 2 * hps.enc_layers + 3 * hps.dec_layers + 1
     keys = iter(jax.random.split(key, n_keys))
 
@@ -183,11 +179,17 @@ def _encoder_stack(params: Params, hps: HParams, x: Array,
                    enc_mask: Array) -> Array:
     """x: [B, T_enc, H]; enc_mask: [B, T_enc] -> [B, T_enc, H] (f32)."""
     attn_mask = enc_mask[:, None, :]  # every query sees all real keys
-    for layer in params["encoder"]["layers"]:
+
+    def layer_fn(layer, x, attn_mask):
         h = _ln(layer["ln1"], x)
         a, _ = _mha(hps, layer["self_attn"], h, h, attn_mask)
         x = x + a
-        x = x + _ffn_block(layer["ffn"], _ln(layer["ln2"], x))
+        return x + _ffn_block(layer["ffn"], _ln(layer["ln2"], x))
+
+    if hps.remat:  # recompute layer activations in backward (HBM <- FLOPs)
+        layer_fn = jax.checkpoint(layer_fn)
+    for layer in params["encoder"]["layers"]:
+        x = layer_fn(layer, x, attn_mask)
     return _ln(params["encoder"]["ln_out"], x).astype(jnp.float32)
 
 
@@ -196,10 +198,10 @@ def _encoder_stack(params: Params, hps: HParams, x: Array,
 # --------------------------------------------------------------------------
 
 class TransformerEncView(NamedTuple):
-    """Per-batch encoder view for decoding: final encoder states plus the
-    per-layer cross-attention K/V, precomputed once per article."""
+    """Per-batch encoder view for decoding: the per-layer cross-attention
+    K/V, precomputed once per article (the raw encoder states are fully
+    consumed by this projection — no other decode-time reader)."""
 
-    enc_out: Array  # [B, T_enc, H] f32
     cross_k: Array  # [B, L, T_enc, nh, hd]
     cross_v: Array  # [B, L, T_enc, nh, hd]
 
@@ -236,8 +238,8 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
     y = _embed_dec(params, hps, arrays["dec_batch"], jnp.arange(T_dec))
     causal = jnp.tril(jnp.ones((T_dec, T_dec), jnp.float32))[None]
     cross_mask = enc_mask[:, None, :]  # [B, 1, T_enc]
-    attn_dist = None
-    for layer in params["decoder"]["layers"]:
+
+    def layer_fn(layer, y, enc_out_c, causal, cross_mask):
         hn = _ln(layer["ln1"], y)
         a, _ = _mha(hps, layer["self_attn"], hn, hn, causal)
         y = y + a
@@ -245,6 +247,13 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
                         enc_out_c, cross_mask)
         y = y + c
         y = y + _ffn_block(layer["ffn"], _ln(layer["ln2"], y))
+        return y, c, probs
+
+    if hps.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    attn_dist = None
+    for layer in params["decoder"]["layers"]:
+        y, c, probs = layer_fn(layer, y, enc_out_c, causal, cross_mask)
         attn_dist = probs  # final layer's head-averaged copy distribution
         cross_ctx = c
     h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
@@ -299,8 +308,7 @@ def beam_encode(params: Params, hps: HParams, arrays: Dict[str, Array],
         p = layer["cross_attn"]
         ks.append(_split_heads(hps, enc_c @ p["wk"]))
         vs.append(_split_heads(hps, enc_c @ p["wv"]))
-    return TransformerEncView(enc_out=enc_out,
-                              cross_k=jnp.stack(ks, axis=1),
+    return TransformerEncView(cross_k=jnp.stack(ks, axis=1),
                               cross_v=jnp.stack(vs, axis=1))
 
 

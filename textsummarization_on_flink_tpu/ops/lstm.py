@@ -48,23 +48,40 @@ def lstm_cell(params: Dict[str, Array], x: Array, state: LSTMState,
 
 
 def unidirectional_scan(params: Dict[str, Array], inputs: Array, mask: Array,
-                        init_state: LSTMState) -> Tuple[Array, LSTMState]:
+                        init_state: LSTMState,
+                        forget_bias: float = 1.0) -> Tuple[Array, LSTMState]:
     """Run an LSTM over time with dynamic_rnn length semantics.
 
     inputs: [B, T, I]; mask: [B, T] (1.0 for valid steps).
     Returns outputs [B, T, H] (zeroed past each length) and the final state
     (frozen at each sequence's last valid step).
+
+    MXU layout: the input half of the fused TF1 kernel is applied to the
+    WHOLE sequence as one [B, T, I] @ [I, 4H] matmul before the scan (a
+    single large tile instead of T skinny ones); only the recurrent
+    h @ k_h half stays inside the scan.  Same math as lstm_cell — the
+    fused z = [x, h] @ kernel splits exactly into x @ k_x + h @ k_h.
     """
+    I = inputs.shape[-1]
+    kernel = params["kernel"].astype(inputs.dtype)
+    bias = params["bias"].astype(inputs.dtype)
+    k_x, k_h = kernel[:I], kernel[I:]
+    x_proj = inputs @ k_x + bias  # [B, T, 4H], hoisted out of the scan
 
     def step(state, xm):
-        x, m = xm
+        xp, m = xm
         m = m[:, None]
-        out, (new_c, new_h) = lstm_cell(params, x, state)
-        c = jnp.where(m > 0, new_c, state[0])
-        h = jnp.where(m > 0, new_h, state[1])
-        return (c, h), out * m
+        c, h = state
+        z = xp + h @ k_h
+        i, j, f, o = jnp.split(z, 4, axis=-1)
+        new_c = c * jax.nn.sigmoid(f + forget_bias) \
+            + jax.nn.sigmoid(i) * jnp.tanh(j)
+        new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+        c = jnp.where(m > 0, new_c, c)
+        h = jnp.where(m > 0, new_h, h)
+        return (c, h), new_h * m
 
-    xs = (jnp.swapaxes(inputs, 0, 1), jnp.swapaxes(mask, 0, 1))
+    xs = (jnp.swapaxes(x_proj, 0, 1), jnp.swapaxes(mask, 0, 1))
     final_state, outs = jax.lax.scan(step, init_state, xs)
     return jnp.swapaxes(outs, 0, 1), final_state
 

@@ -518,13 +518,17 @@ class Evaluator:
                 loss, self.running_avg_loss)
             self.writer.scalars(step, eval_loss=loss,
                                 running_avg_loss=self.running_avg_loss)
+            # best-model check PER eval iteration, inside the loop — the
+            # reference saves whenever the smoothed loss improves after
+            # each eval step (run_summarization.py:281-292), not once per
+            # evaluation session
+            if self.best_loss is None or self.running_avg_loss < self.best_loss:
+                log.info("Found new best model with %.3f running_avg_loss. "
+                         "Saving...", self.running_avg_loss)
+                if self.best_saver is not None:
+                    self.best_saver(params, self.running_avg_loss, step)
+                self.best_loss = self.running_avg_loss
             n += 1
             if max_batches and n >= max_batches:
                 break
-        if self.best_loss is None or self.running_avg_loss < self.best_loss:
-            log.info("Found new best model with %.3f running_avg_loss. Saving...",
-                     self.running_avg_loss)
-            if self.best_saver is not None:
-                self.best_saver(params, self.running_avg_loss, step)
-            self.best_loss = self.running_avg_loss
         return self.running_avg_loss
