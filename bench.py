@@ -47,6 +47,7 @@ _METRIC_BY_MODE = {
     "train": "train_samples_per_sec",
     "decode": "beam_decode_p50_latency_per_article",
     "attention": "attention_pallas_speedup_vs_xla",
+    "flash": "flash_attention_speedup_vs_xla",
 }
 
 
@@ -414,12 +415,89 @@ def bench_attention() -> None:
     print(json.dumps(rec))
 
 
+def bench_flash() -> None:
+    """BENCH_MODE=flash: A/B the transformer's Pallas flash self-attention
+    against the einsum formula at a long-context, lane-aligned scale
+    (T=2048, hd=128) — same-output gate, then a fwd+bwd timing ratio."""
+    import jax
+    import jax.numpy as jnp
+
+    from textsummarization_on_flink_tpu.config import HParams
+    from textsummarization_on_flink_tpu.models import transformer as tfm
+
+    iters = int(os.environ.get("BENCH_STEPS", "30"))
+    B, T = 4, int(os.environ.get("BENCH_FLASH_T", "2048"))
+    hps = HParams(model_family="transformer", hidden_dim=1024, num_heads=8,
+                  max_enc_steps=T, batch_size=B)
+    rng = np.random.RandomState(0)
+    p = {k: jnp.asarray(rng.randn(1024, 1024) * 0.02, jnp.float32)
+         for k in ("wq", "wk", "wv", "wo")}
+    x = jnp.asarray(rng.randn(B, T, 1024) * 0.1, jnp.float32)
+    lens = rng.randint(T // 2, T + 1, size=(B,))
+    mask = jnp.asarray((np.arange(T)[None] < lens[:, None]), jnp.float32)
+
+    def run(flag):
+        os.environ["TS_FLASH"] = flag
+
+        def fwd_bwd(x):
+            def f(x):
+                out = tfm._self_attention(hps, p, x, mask, causal=False)
+                # mask the LOSS: padding-query rows legitimately differ
+                # between the two paths and must not leak gradient into
+                # the real rows being compared
+                return jnp.sum((out * mask[:, :, None]) ** 2)
+            return jax.grad(f)(x)
+        # compile NOW, while the env flag is set — jit traces lazily and
+        # _use_flash reads TS_FLASH at trace time
+        return jax.jit(fwd_bwd).lower(x).compile()
+
+    f_xla, f_flash = run("off"), run("on")
+    g0 = jax.block_until_ready(f_xla(x))
+    g1 = jax.block_until_ready(f_flash(x))
+    # gate correctness on REAL rows only (flash leaves padding-query rows
+    # undefined by design; downstream masks discard them)
+    real = np.asarray(mask)[:, :, None] > 0
+    err = float(jnp.max(jnp.abs(jnp.where(real, g0 - g1, 0.0))))
+    scale = float(jnp.max(jnp.abs(jnp.where(real, g0, 0.0))))
+    if err > 1e-2 * max(scale, 1.0):
+        print(json.dumps({"metric": "flash_attention_speedup_vs_xla",
+                          "value": 0.0, "unit": "x", "vs_baseline": 0.0,
+                          "retryable": False,
+                          "error": f"flash/xla grad mismatch {err} "
+                                   f"(scale {scale})"}))
+        sys.exit(1)
+
+    def timed(fn):
+        fn(x).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(x)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    t_xla, t_flash = timed(f_xla), timed(f_flash)
+    _, info = _device_info()
+    rec = {
+        "metric": "flash_attention_speedup_vs_xla",
+        "value": round(t_xla / t_flash, 3),
+        "unit": "x",
+        "vs_baseline": round(t_xla / t_flash, 3),
+        "xla_ms": round(t_xla * 1e3, 3),
+        "flash_ms": round(t_flash * 1e3, 3),
+        "T": T, "head_dim": 128, "max_grad_err": err,
+    }
+    rec.update(info)
+    print(json.dumps(rec))
+
+
 def child_main() -> None:
     mode = os.environ.get("BENCH_MODE", "train")
     if mode == "decode":
         bench_decode()
     elif mode == "attention":
         bench_attention()
+    elif mode == "flash":
+        bench_flash()
     elif mode == "train":
         bench_train()
     else:
@@ -427,7 +505,7 @@ def child_main() -> None:
                           "unit": "n/a", "vs_baseline": 0.0,
                           "retryable": False,
                           "error": f"unknown BENCH_MODE={mode!r} "
-                                   f"(train/decode/attention)"}))
+                                   f"(train/decode/attention/flash)"}))
         sys.exit(2)
 
 

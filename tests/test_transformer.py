@@ -123,6 +123,31 @@ def test_kv_cache_matches_teacher_forcing(setup):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_flash_gating():
+    """Flash self-attention only engages on lane-aligned long shapes (and
+    never on CPU in auto mode); TS_FLASH=off always wins."""
+    import os
+
+    hps_small = tiny_hps()  # hd=4 -> never aligned
+    assert not tfm._use_flash(hps_small, 400)
+    hps_big = tiny_hps(hidden_dim=1024, num_heads=8)  # hd=128
+    old = os.environ.get("TS_FLASH")
+    try:
+        os.environ["TS_FLASH"] = "on"
+        assert tfm._use_flash(hps_big, 1024)
+        assert not tfm._use_flash(hps_big, 400)  # T not lane-aligned
+        os.environ["TS_FLASH"] = "off"
+        assert not tfm._use_flash(hps_big, 1024)
+        os.environ["TS_FLASH"] = "auto"
+        # auto requires a TPU backend; tests run on CPU
+        assert not tfm._use_flash(hps_big, 1024)
+    finally:
+        if old is None:
+            os.environ.pop("TS_FLASH", None)
+        else:
+            os.environ["TS_FLASH"] = old
+
+
 def test_remat_gradient_parity(setup):
     """--remat recomputes layer activations in backward; gradients must
     match the stored-activation path (up to FP reassociation)."""

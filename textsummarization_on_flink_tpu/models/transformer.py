@@ -45,7 +45,7 @@ step-parity tests exact.
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -175,21 +175,74 @@ def _ffn_block(p: Dict[str, Array], x: Array) -> Array:
     return jax.nn.gelu(x @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
 
 
+def _use_flash(hps: HParams, T: int) -> bool:
+    """Route self-attention through the Pallas TPU flash kernel when it
+    pays off: long sequences at head widths the kernel tiles natively
+    (the [B, nh, T, T] score tensor never hits HBM).  TS_FLASH=on forces
+    it, =off disables; auto requires TPU + T>=1024 + lane-aligned shapes.
+    Cross-attention never uses it — its probabilities ARE the copy
+    distribution and must be materialized anyway."""
+    import os
+
+    env = os.environ.get("TS_FLASH", "auto").lower()
+    if env in ("0", "off", "false"):
+        return False
+    hd = _head_dim(hps)
+    aligned = T % 128 == 0 and hd % 128 == 0
+    if env in ("1", "on", "true"):
+        return aligned
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        on_tpu = False
+    return on_tpu and aligned and T >= 1024
+
+
+def _self_attention(hps: HParams, p: Dict[str, Array], x_norm: Array,
+                    pad_mask: Optional[Array], causal: bool) -> Array:
+    """Self-attention block used by the encoder (padding mask) and the
+    training decoder (causal).  Dispatches to the Pallas flash kernel on
+    eligible shapes; otherwise the einsum formula via _mha."""
+    T = x_norm.shape[-2]
+    if _use_flash(hps, T):
+        from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+        q = _split_heads(hps, x_norm @ p["wq"])  # [B, T, nh, hd]
+        k = _split_heads(hps, x_norm @ p["wk"])
+        v = _split_heads(hps, x_norm @ p["wv"])
+        q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # [B,nh,T,hd]
+        seg = None
+        if pad_mask is not None and not causal:
+            # padding keys live in a different segment than real tokens,
+            # so real queries never attend them (padding queries produce
+            # garbage rows that downstream masks discard)
+            ids = (pad_mask <= 0).astype(jnp.int32)  # [B, T]
+            seg = fa.SegmentIds(q=ids, kv=ids)
+        out = fa.flash_attention(q, k, v, segment_ids=seg, causal=causal,
+                                 sm_scale=_head_dim(hps) ** -0.5)
+        return _merge_heads(jnp.swapaxes(out, 1, 2)) @ p["wo"]
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), jnp.float32))[None]
+    else:
+        mask = pad_mask[:, None, :]
+    out, _ = _mha(hps, p, x_norm, x_norm, mask)
+    return out
+
+
 def _encoder_stack(params: Params, hps: HParams, x: Array,
                    enc_mask: Array) -> Array:
     """x: [B, T_enc, H]; enc_mask: [B, T_enc] -> [B, T_enc, H] (f32)."""
-    attn_mask = enc_mask[:, None, :]  # every query sees all real keys
 
-    def layer_fn(layer, x, attn_mask):
-        h = _ln(layer["ln1"], x)
-        a, _ = _mha(hps, layer["self_attn"], h, h, attn_mask)
+    def layer_fn(layer, x, enc_mask):
+        a = _self_attention(hps, layer["self_attn"], _ln(layer["ln1"], x),
+                            enc_mask, causal=False)
         x = x + a
         return x + _ffn_block(layer["ffn"], _ln(layer["ln2"], x))
 
     if hps.remat:  # recompute layer activations in backward (HBM <- FLOPs)
         layer_fn = jax.checkpoint(layer_fn)
     for layer in params["encoder"]["layers"]:
-        x = layer_fn(layer, x, attn_mask)
+        x = layer_fn(layer, x, enc_mask)
     return _ln(params["encoder"]["ln_out"], x).astype(jnp.float32)
 
 
@@ -236,12 +289,11 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
     enc_out_c = pg._cast(hps, enc_out)
 
     y = _embed_dec(params, hps, arrays["dec_batch"], jnp.arange(T_dec))
-    causal = jnp.tril(jnp.ones((T_dec, T_dec), jnp.float32))[None]
     cross_mask = enc_mask[:, None, :]  # [B, 1, T_enc]
 
-    def layer_fn(layer, y, enc_out_c, causal, cross_mask):
-        hn = _ln(layer["ln1"], y)
-        a, _ = _mha(hps, layer["self_attn"], hn, hn, causal)
+    def layer_fn(layer, y, enc_out_c, cross_mask):
+        a = _self_attention(hps, layer["self_attn"], _ln(layer["ln1"], y),
+                            None, causal=True)
         y = y + a
         c, probs = _mha(hps, layer["cross_attn"], _ln(layer["ln_cross"], y),
                         enc_out_c, cross_mask)
@@ -253,7 +305,7 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
         layer_fn = jax.checkpoint(layer_fn)
     attn_dist = None
     for layer in params["decoder"]["layers"]:
-        y, c, probs = layer_fn(layer, y, enc_out_c, causal, cross_mask)
+        y, c, probs = layer_fn(layer, y, enc_out_c, cross_mask)
         attn_dist = probs  # final layer's head-averaged copy distribution
         cross_ctx = c
     h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
