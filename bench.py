@@ -24,13 +24,18 @@ Modes (BENCH_MODE):
   decode          — batched on-device beam search: p50/p99 latency per
                     article + decoded tokens/sec.  (The reference pays
                     ~100 feed_dict round-trips per article, SURVEY §3.4.)
-  attention       — A/B the fused Pallas attention kernel vs the XLA
-                    formula at reference scale and long-context scale.
+  attention       — A/B the fused Pallas additive-attention kernel vs the
+                    XLA formula at reference scale and long-context scale.
+  flash           — A/B the transformer's Pallas flash self-attention vs
+                    the einsum formula (fwd+bwd) at T=BENCH_FLASH_T
+                    (default 2048), head_dim 128.  TPU only.
 
 Env overrides: BENCH_STEPS (20), BENCH_WARMUP (3), BENCH_BATCH (16),
-BENCH_PRESET=tiny (smoke scale), BENCH_TIMEOUT (600s per attempt),
-BENCH_ATTEMPTS (2), BENCH_PLATFORM=cpu (force CPU child for smoke runs),
-BENCH_PEAK_TFLOPS (override the per-chip bf16 peak used for MFU).
+BENCH_PRESET=tiny (smoke scale), BENCH_FAMILY=transformer (bench the
+second model family), BENCH_FLASH_T (flash-mode sequence length),
+BENCH_TIMEOUT (600s per attempt), BENCH_ATTEMPTS (2), BENCH_PLATFORM=cpu
+(force CPU child for smoke runs), BENCH_PEAK_TFLOPS (override the
+per-chip bf16 peak used for MFU).
 """
 
 from __future__ import annotations

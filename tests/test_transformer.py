@@ -7,6 +7,7 @@ search (adapter protocol), checkpointing (list-bearing pytrees), and the
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -123,29 +124,55 @@ def test_kv_cache_matches_teacher_forcing(setup):
                                    rtol=1e-4, atol=1e-5)
 
 
-def test_flash_gating():
-    """Flash self-attention only engages on lane-aligned long shapes (and
-    never on CPU in auto mode); TS_FLASH=off always wins."""
-    import os
-
+def test_flash_gating(monkeypatch):
+    """Flash self-attention only engages on lane-aligned long shapes;
+    TS_FLASH=off always wins; auto requires a TPU backend."""
     hps_small = tiny_hps()  # hd=4 -> never aligned
     assert not tfm._use_flash(hps_small, 400)
     hps_big = tiny_hps(hidden_dim=1024, num_heads=8)  # hd=128
-    old = os.environ.get("TS_FLASH")
-    try:
-        os.environ["TS_FLASH"] = "on"
-        assert tfm._use_flash(hps_big, 1024)
-        assert not tfm._use_flash(hps_big, 400)  # T not lane-aligned
-        os.environ["TS_FLASH"] = "off"
-        assert not tfm._use_flash(hps_big, 1024)
-        os.environ["TS_FLASH"] = "auto"
-        # auto requires a TPU backend; tests run on CPU
-        assert not tfm._use_flash(hps_big, 1024)
-    finally:
-        if old is None:
-            os.environ.pop("TS_FLASH", None)
-        else:
-            os.environ["TS_FLASH"] = old
+    monkeypatch.setenv("TS_FLASH", "on")
+    assert tfm._use_flash(hps_big, 1024)
+    assert not tfm._use_flash(hps_big, 400)  # T not lane-aligned
+    monkeypatch.setenv("TS_FLASH", "off")
+    assert not tfm._use_flash(hps_big, 1024)
+    monkeypatch.setenv("TS_FLASH", "auto")
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert not tfm._use_flash(hps_big, 1024)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert tfm._use_flash(hps_big, 1024)
+    assert not tfm._use_flash(hps_big, 512)  # auto needs T >= 1024
+
+
+def test_flash_branch_matches_einsum_interpret(monkeypatch):
+    """Execute the ACTUAL flash branch (segment ids, head transposes,
+    sm_scale) in Pallas interpret mode on CPU and compare real-row outputs
+    against the einsum path."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    hps = tiny_hps(hidden_dim=128, num_heads=1)  # hd=128, lane-aligned
+    T, B, H = 128, 2, 128
+    rng = np.random.RandomState(0)
+    p = {k: jnp.asarray(rng.randn(H, H) * 0.05, jnp.float32)
+         for k in ("wq", "wk", "wv", "wo")}
+    x = jnp.asarray(rng.randn(B, T, H) * 0.3, jnp.float32)
+    lens = np.array([T, T // 2])
+    mask = jnp.asarray((np.arange(T)[None] < lens[:, None]), jnp.float32)
+
+    monkeypatch.setenv("TS_FLASH", "off")
+    ref = tfm._self_attention(hps, p, x, mask, causal=False)
+    monkeypatch.setenv("TS_FLASH", "on")
+    assert tfm._use_flash(hps, T)
+    with pltpu.force_tpu_interpret_mode():
+        got = tfm._self_attention(hps, p, x, mask, causal=False)
+        got_causal = tfm._self_attention(hps, p, x, None, causal=True)
+    monkeypatch.setenv("TS_FLASH", "off")
+    ref_causal = tfm._self_attention(hps, p, x, None, causal=True)
+    real = np.asarray(mask)[:, :, None] > 0
+    np.testing.assert_allclose(np.where(real, np.asarray(got), 0),
+                               np.where(real, np.asarray(ref), 0),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(got_causal), np.asarray(ref_causal),
+                               rtol=2e-3, atol=2e-3)
 
 
 def test_remat_gradient_parity(setup):
@@ -241,6 +268,55 @@ def test_tp_shards_megatron_layout(setup):
     assert layer["ffn"]["w1"].sharding.spec == mesh_lib.P(None, "tp")
     assert layer["ffn"]["w2"].sharding.spec == mesh_lib.P("tp", None)
     assert layer["ln1"]["scale"].sharding.spec == mesh_lib.P()
+
+
+def test_estimator_pipeline_with_transformer(tmp_path):
+    """The reference's testInferenceAfterTraining path (fit -> transform,
+    weights via checkpoint dir) with model_family=transformer selected
+    through the hyper-params argv string — the full L6 pipeline surface."""
+    import shlex
+
+    from textsummarization_on_flink_tpu.pipeline import estimator as est_lib
+    from textsummarization_on_flink_tpu.pipeline.io import (
+        CollectionSink,
+        CollectionSource,
+        DataTypes,
+    )
+
+    words = ("article reference the a quick brown fox jumped over lazy dog "
+             "0 1 2 3 4 5 6 7").split()
+    vocab = Vocab(words=words)
+
+    def hp(mode):
+        hps = HParams(mode=mode, num_steps=2, batch_size=4, hidden_dim=8,
+                      emb_dim=8, vocab_size=24, max_enc_steps=12,
+                      max_dec_steps=6, beam_size=2, min_dec_steps=1,
+                      max_oov_buckets=4, log_root=str(tmp_path),
+                      exp_name="exp", model_family="transformer",
+                      num_heads=2, enc_layers=1, dec_layers=1)
+        return shlex.split(hps.to_argv())
+
+    e = est_lib.SummarizationEstimator()
+    (e.set_train_selected_cols(["uuid", "article", "reference"])
+      .set_train_output_cols(["uuid"])
+      .set_train_output_types([DataTypes.STRING]))
+    e.set_train_hyper_params(hp("train"))
+    (e.set_inference_selected_cols(["uuid", "article", "reference"])
+      .set_inference_output_cols(["uuid", "article", "summary", "reference"])
+      .set_inference_output_types([DataTypes.STRING] * 4))
+    e.set_inference_hyper_params(hp("decode"))
+    e.with_vocab(vocab)
+
+    rows = [(f"uuid-{i}", f"article {i} .", "", f"reference {i} .")
+            for i in range(8)]
+    model = e.fit(CollectionSource(rows))
+    sink = CollectionSink()
+    model.with_vocab(vocab)
+    model.transform(CollectionSource(rows), sink)
+    assert len(sink.rows) == 8
+    for uuid, article, summary, reference in sink.rows:
+        assert uuid.startswith("uuid-")
+        assert isinstance(summary, str)
 
 
 def test_decoder_serving_end_to_end(setup, tmp_path):

@@ -154,6 +154,17 @@ def _cast(hps: HParams, x: Array) -> Array:
     return x.astype(jnp.bfloat16) if hps.compute_dtype == "bfloat16" else x
 
 
+def _proj(hps: HParams, x: Array, w: Array) -> Array:
+    """x @ w with bf16 operands + f32 accumulation in bfloat16 mode — the
+    [H, vocab] output projection is the FLOP-dominant matmul (SURVEY §7.2
+    step 7 note); casting it to the MXU's native bf16 roughly doubles its
+    throughput while the f32 accumulator keeps softmax-grade precision."""
+    if hps.compute_dtype == "bfloat16":
+        return jnp.dot(x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+    return x @ w
+
+
 def encode(params: Params, hps: HParams, enc_batch: Array, enc_lens: Array,
            enc_padding_mask: Array) -> EncoderOutput:
     """Embed + biLSTM + state reduction (model.py:210-221)."""
@@ -226,7 +237,7 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
         x = emb_proj_t + context @ k_ctx
         res = _decoder_core(params, hps, enc, arrays["enc_padding_mask"],
                             state, context, coverage, x)
-        vocab_scores = res["output"] @ w + v  # [B, V]
+        vocab_scores = _proj(hps, res["output"], w) + v  # [B, V]
         vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
         if hps.pointer_gen:
             gold = loss_ops.gold_mixture_prob(
@@ -325,7 +336,7 @@ def decode_onestep(params: Params, hps: HParams, enc: EncoderOutput,
     p_gen = jax.nn.sigmoid(
         _linear(dp["pgen_linear"], context, new_state[0], new_state[1], x))[:, 0]
     output = _linear(dp["output_linear"], cell_out, context)
-    vocab_scores = output @ params["output_projection"]["w"] + \
+    vocab_scores = _proj(hps, output, params["output_projection"]["w"]) + \
         params["output_projection"]["v"]
     vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
     if hps.pointer_gen:

@@ -310,7 +310,7 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
         cross_ctx = c
     h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
 
-    logits = (h @ params["embedding"].T.astype(h.dtype)
+    logits = (pg._proj(hps, h, params["embedding"].T)
               + params["out_bias"])  # [B, T_dec, V] tied projection
     p_gens = jax.nn.sigmoid(
         jnp.concatenate([h, cross_ctx.astype(jnp.float32)], axis=-1)
@@ -427,7 +427,8 @@ def beam_adapter(hps: HParams):
             attn_dist = jnp.mean(cprobs, axis=1)  # [K, T_enc] head-avg
             cross_ctx = cross_out
         h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
-        vocab_scores = h @ params["embedding"].T + params["out_bias"]
+        vocab_scores = pg._proj(hps, h, params["embedding"].T) \
+            + params["out_bias"]
         vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
         p_gen = jax.nn.sigmoid(
             jnp.concatenate([h, cross_ctx.astype(jnp.float32)], axis=-1)
