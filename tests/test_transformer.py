@@ -255,6 +255,79 @@ def test_sharded_train_step_matches_single_device(setup, dp, tp, sp):
                                    atol=1e-6)
 
 
+def test_ring_attention_op_matches_full_attention():
+    """Standalone ring op vs full masked softmax attention on a 4-device
+    sp ring (padding spanning whole blocks included)."""
+    from jax.sharding import Mesh
+    from textsummarization_on_flink_tpu.parallel import ring_attention as ra
+
+    devs = np.asarray(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    B, T, nh, hd = 2, 32, 2, 8
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(B, T, nh, hd), jnp.float32)
+               for _ in range(3))
+    lens = np.array([T, T // 4])  # row 1: 3 of 4 blocks are pure padding
+    mask = jnp.asarray((np.arange(T)[None] < lens[:, None]), jnp.float32)
+    scale = hd ** -0.5
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k) * scale
+    logits = jnp.where(mask[:, None, None, :] > 0, logits, -1e30)
+    p = jax.nn.softmax(logits, -1) * (mask[:, None, None, :] > 0)
+    ref = jnp.einsum("bnqk,bknd->bqnd", p, v)
+    out = jax.jit(ra.make_ring_attention(mesh, "sp"))(q, k, v, mask, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ring_attention_sharded_step_matches_single_device(setup):
+    """Full transformer train step with --ring_attention under a
+    (dp=2, sp=4) mesh == the single-device step without it."""
+    hps, vocab, batch, state = setup
+    single = jax.jit(trainer_lib.make_train_step(hps))
+    ref_state, ref_metrics = single(state, batch.as_arrays())
+
+    hps_m = hps.replace(dp=2, tp=1, sp=4, ring_attention=True)
+    plan = mesh_lib.make_mesh(hps_m)
+    sharded_state = mesh_lib.shard_train_state(plan, state)
+    step = mesh_lib.make_sharded_train_step(plan, donate=False)
+    new_state, metrics = step(sharded_state, batch.as_arrays())
+    np.testing.assert_allclose(float(metrics.loss), float(ref_metrics.loss),
+                               rtol=2e-5)
+    ref_leaves = jax.tree_util.tree_leaves(jax.device_get(ref_state.params))
+    got_leaves = jax.tree_util.tree_leaves(jax.device_get(new_state.params))
+    for r, g in zip(ref_leaves, got_leaves):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-4,
+                                   atol=1e-6)
+
+
+def test_ring_attention_rejects_tp(setup):
+    hps, vocab, batch, state = setup
+    with pytest.raises(ValueError, match="ring_attention with tp>1"):
+        mesh_lib.validate_divisibility(
+            hps.replace(dp=2, tp=2, sp=2, ring_attention=True), state.params)
+
+
+def test_ring_attention_serving_matches_plain(setup):
+    """Sharded beam search under --ring_attention (sp>1) returns the same
+    hypotheses as the single-device search without it — the serving path
+    gets the mesh context too."""
+    hps, vocab, batch, state = setup
+    enc_only = {k: v for k, v in batch.as_arrays().items()
+                if k.startswith("enc_")}
+    plain = beam_search.run_beam_search(state.params, hps, enc_only)
+    hps_m = hps.replace(dp=2, tp=1, sp=4, ring_attention=True,
+                        mode="decode")
+    plan = mesh_lib.make_mesh(hps_m)
+    fn = mesh_lib.make_sharded_beam_search(plan)
+    sharded_params = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, plan.named(s)), state.params,
+        mesh_lib.param_pspecs(state.params),
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+    out = fn(sharded_params, mesh_lib.shard_batch(plan, enc_only))
+    np.testing.assert_array_equal(np.asarray(out.tokens), plain.tokens)
+    np.testing.assert_array_equal(np.asarray(out.length), plain.length)
+
+
 def test_tp_shards_megatron_layout(setup):
     hps, vocab, batch, state = setup
     plan = mesh_lib.make_mesh(hps.replace(dp=4, tp=2))

@@ -92,6 +92,13 @@ class HParams:
     # trades ~1/3 more FLOPs for O(layers) less activation HBM — for the
     # long-context configs (enc 800+) where activations dominate
     remat: bool = False
+    # ring attention: sequence-parallel transformer encoder self-attention
+    # over the sp mesh axis (K/V blocks rotate via ppermute; no device
+    # ever holds the full [T, T] score matrix).  Engages wherever an sp>1
+    # mesh is active — sharded train/eval steps AND the sharded beam
+    # search; on a single device (all mesh axes 1) it falls back to
+    # flash/einsum attention.  Incompatible with tp>1 (validated).
+    ring_attention: bool = False
 
     # -- derived --
     @property

@@ -201,9 +201,23 @@ def _use_flash(hps: HParams, T: int) -> bool:
 def _self_attention(hps: HParams, p: Dict[str, Array], x_norm: Array,
                     pad_mask: Optional[Array], causal: bool) -> Array:
     """Self-attention block used by the encoder (padding mask) and the
-    training decoder (causal).  Dispatches to the Pallas flash kernel on
-    eligible shapes; otherwise the einsum formula via _mha."""
+    training decoder (causal).  Dispatch order: ring attention when
+    sequence-parallel (--ring_attention under an sp>1 mesh), then the
+    Pallas flash kernel on eligible shapes, then the einsum formula."""
     T = x_norm.shape[-2]
+    if hps.ring_attention and not causal and pad_mask is not None:
+        from textsummarization_on_flink_tpu.parallel import (
+            ring_attention as ra,
+        )
+
+        mesh = ra.current_mesh()
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            q = _split_heads(hps, x_norm @ p["wq"])  # [B, T, nh, hd]
+            k = _split_heads(hps, x_norm @ p["wk"])
+            v = _split_heads(hps, x_norm @ p["wv"])
+            fn = ra.make_ring_attention(mesh, "sp")
+            out = fn(q, k, v, pad_mask, _head_dim(hps) ** -0.5)
+            return _merge_heads(out) @ p["wo"]
     if _use_flash(hps, T):
         from jax.experimental.pallas.ops.tpu import flash_attention as fa
 

@@ -181,6 +181,17 @@ def shard_batch(plan: MeshPlan, arrays: Dict[str, Any]) -> Dict[str, Any]:
 # Sharded step functions
 # --------------------------------------------------------------------------
 
+def _with_mesh_context(plan: MeshPlan, fn):
+    """Expose the plan's mesh to model code while the step traces, so
+    mesh-aware ops (ring attention's shard_map) can bind to it."""
+    from textsummarization_on_flink_tpu.parallel import ring_attention as ra
+
+    def wrapped(*args):
+        with ra.mesh_context(plan.mesh):
+            return fn(*args)
+
+    return wrapped
+
 def param_shardings(plan: MeshPlan, params: Optional[PyTree] = None):
     """NamedSharding tree for a parameter pytree; pass `params` when its
     structure differs from a fresh init (e.g. TF1-imported trees)."""
@@ -208,7 +219,7 @@ def make_sharded_train_step(plan: MeshPlan, donate: bool = True,
     structure matches.
     """
     hps = plan.hps
-    step_fn = trainer_lib.make_train_step(hps)
+    step_fn = _with_mesh_context(plan, trainer_lib.make_train_step(hps))
     probe = state if state is not None else jax.eval_shape(
         # structure only, nothing allocated
         lambda: trainer_lib.init_train_state(hps, hps.vocab_size, seed=0))
@@ -233,7 +244,7 @@ def make_sharded_eval_step(plan: MeshPlan, params: Optional[PyTree] = None):
     (e.g. a TF1-imported checkpoint) so in_shardings match, mirroring
     make_sharded_train_step's `state` parameter."""
     hps = plan.hps
-    eval_fn = trainer_lib.make_eval_step(hps)
+    eval_fn = _with_mesh_context(plan, trainer_lib.make_eval_step(hps))
     param_sh = param_shardings(plan, params)
     batch_sh = batch_sharding(plan)
     metric_sh = trainer_lib.StepMetrics(
@@ -270,6 +281,12 @@ def validate_divisibility(hps: HParams, params: Optional[PyTree] = None,
         if hps.ffn_width % hps.tp != 0:
             raise ValueError(f"tensor-parallel axis tp={hps.tp} must divide "
                              f"ffn_dim={hps.ffn_width}")
+        if hps.ring_attention:
+            raise ValueError(
+                "ring_attention with tp>1 is not supported: the ring's "
+                "shard_map replicates the head axis, which would silently "
+                "all-gather the Megatron-sharded q/k/v every layer — use "
+                "sp-only ring attention (tp=1) or tp without the ring")
 
 
 def make_sharded_beam_search(plan: MeshPlan,
@@ -298,6 +315,10 @@ def make_sharded_beam_search(plan: MeshPlan,
     def search(p, arrays):
         return beam_search._search_batch(p, hps, arrays)
 
+    # mesh context so the encoder's ring attention engages in serving too
+    # (a model trained with --ring_attention because [T,T] doesn't fit one
+    # device must not fall back to full attention at decode time)
+    search = _with_mesh_context(plan, search)
     return jax.jit(search, in_shardings=(param_sh, batch_sh),
                    out_shardings=out_sh)
 
