@@ -78,7 +78,10 @@ def supervise() -> None:
     mode = os.environ.get("BENCH_MODE", "train")
     metric = _METRIC_BY_MODE.get(mode, f"bench_{mode}")
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
-    timeout = float(os.environ.get("BENCH_TIMEOUT", "600"))
+    # the full-scale beam-search while_loop takes a long first compile;
+    # give non-train modes more headroom by default
+    default_timeout = "600" if mode == "train" else "1200"
+    timeout = float(os.environ.get("BENCH_TIMEOUT", default_timeout))
     repo_root = os.path.dirname(os.path.abspath(__file__))
     last_err = "no attempts made"
     for attempt in range(1, attempts + 1):

@@ -205,6 +205,7 @@ def _self_attention(hps: HParams, p: Dict[str, Array], x_norm: Array,
     sequence-parallel (--ring_attention under an sp>1 mesh), then the
     Pallas flash kernel on eligible shapes, then the einsum formula."""
     T = x_norm.shape[-2]
+    ring_mesh = None
     if hps.ring_attention and not causal and pad_mask is not None:
         from textsummarization_on_flink_tpu.parallel import (
             ring_attention as ra,
@@ -212,18 +213,21 @@ def _self_attention(hps: HParams, p: Dict[str, Array], x_norm: Array,
 
         mesh = ra.current_mesh()
         if mesh is not None and mesh.shape.get("sp", 1) > 1:
-            q = _split_heads(hps, x_norm @ p["wq"])  # [B, T, nh, hd]
-            k = _split_heads(hps, x_norm @ p["wk"])
-            v = _split_heads(hps, x_norm @ p["wv"])
-            fn = ra.make_ring_attention(mesh, "sp")
-            out = fn(q, k, v, pad_mask, _head_dim(hps) ** -0.5)
-            return _merge_heads(out) @ p["wo"]
-    if _use_flash(hps, T):
-        from jax.experimental.pallas.ops.tpu import flash_attention as fa
-
+            ring_mesh = mesh
+    use_flash = ring_mesh is None and _use_flash(hps, T)
+    if ring_mesh is not None or use_flash:
+        # shared head projection for both kernel paths — one site to
+        # change if the projection ever grows biases or dtype casts
         q = _split_heads(hps, x_norm @ p["wq"])  # [B, T, nh, hd]
         k = _split_heads(hps, x_norm @ p["wk"])
         v = _split_heads(hps, x_norm @ p["wv"])
+        sm_scale = _head_dim(hps) ** -0.5
+    if ring_mesh is not None:
+        fn = ra.make_ring_attention(ring_mesh, "sp")
+        return _merge_heads(fn(q, k, v, pad_mask, sm_scale)) @ p["wo"]
+    if use_flash:
+        from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
         q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # [B,nh,T,hd]
         seg = None
         if pad_mask is not None and not causal:
@@ -233,7 +237,7 @@ def _self_attention(hps: HParams, p: Dict[str, Array], x_norm: Array,
             ids = (pad_mask <= 0).astype(jnp.int32)  # [B, T]
             seg = fa.SegmentIds(q=ids, kv=ids)
         out = fa.flash_attention(q, k, v, segment_ids=seg, causal=causal,
-                                 sm_scale=_head_dim(hps) ** -0.5)
+                                 sm_scale=sm_scale)
         return _merge_heads(jnp.swapaxes(out, 1, 2)) @ p["wo"]
     if causal:
         mask = jnp.tril(jnp.ones((T, T), jnp.float32))[None]
