@@ -1,0 +1,79 @@
+"""Pure-unit tests for bench.py's analytic models and config plumbing.
+
+The MFU number the driver records is only as trustworthy as the FLOPs
+model behind it; pin its basic invariants (no child processes spawned
+here — the JSON contract is exercised by the driver and the verify
+drives)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+bench = importlib.util.module_from_spec(spec)
+sys.modules["bench"] = bench
+spec.loader.exec_module(bench)
+
+from textsummarization_on_flink_tpu.config import HParams  # noqa: E402
+
+
+def test_pg_flops_positive_and_linear_in_batch():
+    hps1 = HParams(batch_size=1)
+    hps8 = HParams(batch_size=8)
+    f1 = bench.train_flops_per_step(hps1)
+    f8 = bench.train_flops_per_step(hps8)
+    assert f1 > 0
+    assert f8 == pytest.approx(8 * f1)
+
+
+def test_pg_flops_dominated_by_vocab_projection():
+    """At reference scale the H x 50k projection dominates (SURVEY §7.2);
+    halving the vocab should cut total FLOPs by a large fraction."""
+    full = bench.train_flops_per_step(HParams(batch_size=16))
+    half = bench.train_flops_per_step(
+        HParams(batch_size=16, vocab_size=25000))
+    assert half < 0.75 * full
+
+
+def test_transformer_flops_positive_linear_and_layer_scaled():
+    hps = HParams(model_family="transformer", batch_size=4)
+    f = bench.transformer_flops_per_step(hps)
+    assert f > 0
+    assert bench.transformer_flops_per_step(
+        hps.replace(batch_size=8)) == pytest.approx(2 * f)
+    deeper = bench.transformer_flops_per_step(
+        hps.replace(enc_layers=12, dec_layers=12))
+    assert deeper > f
+
+
+def test_peak_flops_env_override(monkeypatch):
+    monkeypatch.setenv("BENCH_PEAK_TFLOPS", "123.5")
+    assert bench.peak_flops_for(object()) == pytest.approx(123.5e12)
+
+
+def test_peak_flops_known_device_kinds(monkeypatch):
+    monkeypatch.delenv("BENCH_PEAK_TFLOPS", raising=False)
+
+    class Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    assert bench.peak_flops_for(Dev("TPU v4")) == pytest.approx(275e12)
+    assert bench.peak_flops_for(Dev("TPU v5e")) == pytest.approx(197e12)
+    assert bench.peak_flops_for(Dev("Banana9000")) is None
+
+
+def test_preset_overrides_family(monkeypatch):
+    monkeypatch.setenv("BENCH_PRESET", "tiny")
+    monkeypatch.setenv("BENCH_FAMILY", "transformer")
+    o = bench._preset_overrides()
+    assert o["model_family"] == "transformer"
+    assert o["hidden_dim"] % o["num_heads"] == 0
+    # the overrides must build a valid HParams
+    HParams(**o).validate()
+    monkeypatch.delenv("BENCH_FAMILY")
+    o2 = bench._preset_overrides()
+    assert "model_family" not in o2
