@@ -160,6 +160,21 @@ def test_output_invariants(params):
             assert row[L:].sum() < 1e-6
 
 
+def test_greedy_beam_size_one_matches_reference(params):
+    """K=1 degenerates to greedy-with-STOP-triage; the candidate pool is
+    2 entries and the step-0 single-hyp rule is a no-op — still must
+    match the host mirror token-for-token."""
+    hps = HPS.replace(beam_size=1)
+    arrays = make_arrays(hps, seed=5)
+    out = beam_search.run_beam_search(params, hps, arrays)
+    for b in range(hps.batch_size):
+        ref = python_reference_search(params, hps, arrays, b)
+        n = int(out.length[b])
+        assert list(out.tokens[b][:n]) == ref.tokens
+        np.testing.assert_allclose(out.avg_log_prob[b], ref.avg,
+                                   rtol=2e-5, atol=2e-6)
+
+
 def test_min_dec_steps_blocks_early_stop(params):
     # with min_dec_steps == max-1, any STOP before the horizon is discarded,
     # so results are either long or the live-beam fallback

@@ -198,6 +198,29 @@ class TestEvaluator:
         assert saved == sorted(saved, reverse=True)
 
 
+class TestProfiler:
+    def test_profile_dir_captures_trace(self, tmp_path, monkeypatch):
+        """TS_PROFILE_DIR wiring (SURVEY §5.1): a training run traces
+        steps 2-7 post-compilation and leaves an XPlane trace on disk."""
+        import os
+
+        from textsummarization_on_flink_tpu.train.trainer import Trainer
+
+        prof_dir = str(tmp_path / "prof")
+        monkeypatch.setenv("TS_PROFILE_DIR", prof_dir)
+        hps = hps_tiny()
+        vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+        batch = make_batch(hps, vocab)
+        tr = Trainer(hps, vocab.size(), FixedBatcher(batch, 12),
+                     train_dir=str(tmp_path / "train"), metrics_every=3)
+        tr.train(num_steps=10)
+        traces = []
+        for root, _, files in os.walk(prof_dir):
+            traces += [f for f in files if f.endswith((".xplane.pb",
+                                                       ".trace.json.gz"))]
+        assert traces, f"no profiler trace written under {prof_dir}"
+
+
 class TestDebugAndMultihostHelpers:
     def test_apply_debug_mode_toggles_jax_debug_nans(self):
         from textsummarization_on_flink_tpu.utils import apply_debug_mode
