@@ -204,8 +204,6 @@ class TestProfiler:
         steps 2-7 post-compilation and leaves an XPlane trace on disk."""
         import os
 
-        from textsummarization_on_flink_tpu.train.trainer import Trainer
-
         prof_dir = str(tmp_path / "prof")
         monkeypatch.setenv("TS_PROFILE_DIR", prof_dir)
         hps = hps_tiny()
