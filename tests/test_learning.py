@@ -22,6 +22,57 @@ from textsummarization_on_flink_tpu.train import trainer as trainer_lib
 WORDS = [f"tok{i}" for i in range(26)]
 
 
+def test_two_phase_coverage_recipe(tmp_path):
+    """The reference's training recipe as ONE flow (SURVEY §5.4): train
+    without coverage, convert the checkpoint (fresh w_c + accumulator),
+    resume WITH coverage, and keep training — step counter continuous,
+    coverage loss live in the summaries."""
+    import json
+    import os
+
+    from textsummarization_on_flink_tpu import cli
+    from textsummarization_on_flink_tpu.checkpoint import (
+        checkpointer as ckpt_lib,
+    )
+    from textsummarization_on_flink_tpu.data.batcher import Batcher
+
+    hps = HParams(hidden_dim=16, emb_dim=8, batch_size=4, max_enc_steps=10,
+                  max_dec_steps=5, beam_size=2, min_dec_steps=1,
+                  vocab_size=30, max_oov_buckets=4,
+                  log_root=str(tmp_path), exp_name="exp")
+    vocab = Vocab(words=WORDS, max_size=hps.vocab_size)
+    rng = np.random.RandomState(0)
+
+    def source():
+        while True:
+            art = " ".join(rng.choice(WORDS, 8))
+            yield art, "<s> " + " ".join(art.split()[:3]) + " </s>"
+
+    def batcher():
+        return Batcher("", vocab, hps, single_pass=False,
+                       example_source=source)
+
+    state = cli.setup_training(hps.replace(num_steps=3), vocab, batcher())
+    assert int(state.step) == 3
+    train_dir = os.path.join(str(tmp_path), "exp", "train")
+
+    out = ckpt_lib.convert_to_coverage_model(train_dir, hps, seed=1)
+    assert out.endswith("_cov_init.npz")
+
+    hps_cov = hps.replace(coverage=True, num_steps=6)
+    state = cli.setup_training(hps_cov, vocab, batcher())
+    assert int(state.step) == 6
+    with open(os.path.join(train_dir, "events.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    # the coverage phase must have RESUMED from the converted step-3 ckpt:
+    # exactly steps 4-6 carry coverage_loss (a silent fresh init would
+    # emit six coverage records starting at step 1)
+    cov_steps = [r["step"] for r in recs if "coverage_loss" in r]
+    assert cov_steps == [4, 5, 6], cov_steps
+    assert all(np.isfinite(r["coverage_loss"]) for r in recs
+               if "coverage_loss" in r)
+
+
 def family_hps(family: str) -> HParams:
     base = dict(batch_size=8, max_enc_steps=10, max_dec_steps=5,
                 beam_size=2, min_dec_steps=1, vocab_size=30,
