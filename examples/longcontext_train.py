@@ -5,10 +5,11 @@ The reference truncates articles to max_enc_steps=400
 it has NO long-context capability.  This example shows the rebuild's
 long-context stack (SURVEY.md §5.7) on the transformer family:
 
-  * ``--ring_attention`` + ``--sp``: the encoder sequence axis shards
-    over the sp mesh ring; K/V blocks rotate via ppermute with an online
-    softmax, so a 16k-token article's [T, T] score matrix never exists
-    on any single chip (parallel/ring_attention.py);
+  * ``--sp_attention=ring`` + ``--sp``: the encoder sequence axis
+    shards over the sp mesh ring; K/V blocks rotate via ppermute with an
+    online softmax, so a 16k-token article's [T, T] score matrix never
+    exists on any single chip (``--sp_attention=ulysses`` instead
+    re-shards sequence->heads via all-to-all; parallel/ring_attention.py);
   * ``--remat``: layer activations recompute in backward, keeping HBM
     flat in depth;
   * ``TS_FLASH=auto``: when a single chip CAN hold a block (head_dim
@@ -24,7 +25,7 @@ parallel; sequence length 4096 = 10x the reference's cap):
         --vocab_path=finished_files/vocab --log_root=log --exp_name=long \
         --model_family=transformer --hidden_dim=512 --num_heads=8 \
         --max_enc_steps=4096 --batch_size=16 --dp=2 --sp=4 \
-        --ring_attention=1 --remat=1 --compute_dtype=bfloat16 \
+        --sp_attention=ring --remat=1 --compute_dtype=bfloat16 \
         --num_steps=1000
 
 Smoke-test on CPU with a virtual mesh:
@@ -47,7 +48,7 @@ SMOKE = [
     "--num_heads=4", "--enc_layers=2", "--dec_layers=2",
     "--max_enc_steps=64", "--max_dec_steps=8", "--vocab_size=64",
     "--max_oov_buckets=8", "--batch_size=4", "--beam_size=2",
-    "--min_dec_steps=1", "--dp=2", "--sp=4", "--ring_attention=1",
+    "--min_dec_steps=1", "--dp=2", "--sp=4", "--sp_attention=ring",
     "--remat=1", "--num_steps=2",
 ]
 

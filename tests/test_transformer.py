@@ -280,13 +280,13 @@ def test_ring_attention_op_matches_full_attention():
 
 
 def test_ring_attention_sharded_step_matches_single_device(setup):
-    """Full transformer train step with --ring_attention under a
+    """Full transformer train step with --sp_attention=ring under a
     (dp=2, sp=4) mesh == the single-device step without it."""
     hps, vocab, batch, state = setup
     single = jax.jit(trainer_lib.make_train_step(hps))
     ref_state, ref_metrics = single(state, batch.as_arrays())
 
-    hps_m = hps.replace(dp=2, tp=1, sp=4, ring_attention=True)
+    hps_m = hps.replace(dp=2, tp=1, sp=4, sp_attention="ring")
     plan = mesh_lib.make_mesh(hps_m)
     sharded_state = mesh_lib.shard_train_state(plan, state)
     step = mesh_lib.make_sharded_train_step(plan, donate=False)
@@ -300,22 +300,69 @@ def test_ring_attention_sharded_step_matches_single_device(setup):
                                    atol=1e-6)
 
 
+def test_ulysses_attention_op_matches_full_attention():
+    """All-to-all SP layout vs full masked softmax attention."""
+    from jax.sharding import Mesh
+    from textsummarization_on_flink_tpu.parallel import ring_attention as ra
+
+    devs = np.asarray(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    B, T, nh, hd = 2, 32, 4, 8  # nh % sp == 0
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.randn(B, T, nh, hd), jnp.float32)
+               for _ in range(3))
+    lens = np.array([T, T // 4])
+    mask = jnp.asarray((np.arange(T)[None] < lens[:, None]), jnp.float32)
+    scale = hd ** -0.5
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k) * scale
+    logits = jnp.where(mask[:, None, None, :] > 0, logits, -1e30)
+    p = jax.nn.softmax(logits, -1) * (mask[:, None, None, :] > 0)
+    ref = jnp.einsum("bnqk,bknd->bqnd", p, v)
+    out = jax.jit(ra.make_sp_attention(mesh, "ulysses"))(q, k, v, mask, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ulysses_sharded_step_matches_single_device(setup):
+    """Full transformer train step with --sp_attention=ulysses under a
+    (dp=2, sp=4) mesh == the single-device step (num_heads=4 % sp ok)."""
+    hps, vocab, batch, state = setup
+    single = jax.jit(trainer_lib.make_train_step(hps))
+    ref_state, ref_metrics = single(state, batch.as_arrays())
+    hps_m = hps.replace(dp=2, tp=1, sp=4, sp_attention="ulysses")
+    mesh_lib.validate_divisibility(hps_m, state.params)
+    plan = mesh_lib.make_mesh(hps_m)
+    sharded_state = mesh_lib.shard_train_state(plan, state)
+    step = mesh_lib.make_sharded_train_step(plan, donate=False)
+    _, metrics = step(sharded_state, batch.as_arrays())
+    np.testing.assert_allclose(float(metrics.loss), float(ref_metrics.loss),
+                               rtol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(setup):
+    hps, vocab, batch, state = setup
+    with pytest.raises(ValueError, match="must divide num_heads"):
+        mesh_lib.validate_divisibility(
+            hps.replace(sp=8, max_enc_steps=16, num_heads=4,
+                        sp_attention="ulysses"))
+
+
 def test_ring_attention_rejects_tp(setup):
     hps, vocab, batch, state = setup
-    with pytest.raises(ValueError, match="ring_attention with tp>1"):
+    with pytest.raises(ValueError, match="sp_attention with tp>1"):
         mesh_lib.validate_divisibility(
-            hps.replace(dp=2, tp=2, sp=2, ring_attention=True), state.params)
+            hps.replace(dp=2, tp=2, sp=2, sp_attention="ring"), state.params)
 
 
 def test_ring_attention_serving_matches_plain(setup):
-    """Sharded beam search under --ring_attention (sp>1) returns the same
-    hypotheses as the single-device search without it — the serving path
-    gets the mesh context too."""
+    """Sharded beam search under --sp_attention=ring (sp>1) returns the
+    same hypotheses as the single-device search without it — the serving
+    path gets the mesh context too."""
     hps, vocab, batch, state = setup
     enc_only = {k: v for k, v in batch.as_arrays().items()
                 if k.startswith("enc_")}
     plain = beam_search.run_beam_search(state.params, hps, enc_only)
-    hps_m = hps.replace(dp=2, tp=1, sp=4, ring_attention=True,
+    hps_m = hps.replace(dp=2, tp=1, sp=4, sp_attention="ring",
                         mode="decode")
     plan = mesh_lib.make_mesh(hps_m)
     fn = mesh_lib.make_sharded_beam_search(plan)

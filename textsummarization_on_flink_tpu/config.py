@@ -92,13 +92,16 @@ class HParams:
     # trades ~1/3 more FLOPs for O(layers) less activation HBM — for the
     # long-context configs (enc 800+) where activations dominate
     remat: bool = False
-    # ring attention: sequence-parallel transformer encoder self-attention
-    # over the sp mesh axis (K/V blocks rotate via ppermute; no device
-    # ever holds the full [T, T] score matrix).  Engages wherever an sp>1
-    # mesh is active — sharded train/eval steps AND the sharded beam
-    # search; on a single device (all mesh axes 1) it falls back to
-    # flash/einsum attention.  Incompatible with tp>1 (validated).
-    ring_attention: bool = False
+    # sequence-parallel transformer encoder self-attention over the sp
+    # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
+    # online softmax — no device ever holds the full [T, T] score
+    # matrix), or "ulysses" (all-to-all re-shard from sequence to heads,
+    # full attention per head group, all-to-all back; needs
+    # num_heads % sp == 0).  Engages wherever an sp>1 mesh is active —
+    # sharded train/eval steps AND the sharded beam search; on a single
+    # device it falls back to flash/einsum attention.  Incompatible with
+    # tp>1 (validated).
+    sp_attention: str = ""
 
     # -- derived --
     @property
@@ -216,3 +219,7 @@ class HParams:
                     f"hidden_dim={self.hidden_dim}")
             if self.enc_layers < 1 or self.dec_layers < 1:
                 raise ValueError("enc_layers/dec_layers must be >= 1")
+        if self.sp_attention not in ("", "ring", "ulysses"):
+            raise ValueError(
+                f"sp_attention must be '', 'ring', or 'ulysses', got "
+                f"{self.sp_attention!r}")

@@ -201,29 +201,29 @@ def _use_flash(hps: HParams, T: int) -> bool:
 def _self_attention(hps: HParams, p: Dict[str, Array], x_norm: Array,
                     pad_mask: Optional[Array], causal: bool) -> Array:
     """Self-attention block used by the encoder (padding mask) and the
-    training decoder (causal).  Dispatch order: ring attention when
-    sequence-parallel (--ring_attention under an sp>1 mesh), then the
-    Pallas flash kernel on eligible shapes, then the einsum formula."""
+    training decoder (causal).  Dispatch order: sequence-parallel
+    attention when --sp_attention=ring|ulysses under an sp>1 mesh, then
+    the Pallas flash kernel on eligible shapes, then the einsum formula."""
     T = x_norm.shape[-2]
-    ring_mesh = None
-    if hps.ring_attention and not causal and pad_mask is not None:
+    sp_mesh = None
+    if hps.sp_attention and not causal and pad_mask is not None:
         from textsummarization_on_flink_tpu.parallel import (
             ring_attention as ra,
         )
 
         mesh = ra.current_mesh()
         if mesh is not None and mesh.shape.get("sp", 1) > 1:
-            ring_mesh = mesh
-    use_flash = ring_mesh is None and _use_flash(hps, T)
-    if ring_mesh is not None or use_flash:
+            sp_mesh = mesh
+    use_flash = sp_mesh is None and _use_flash(hps, T)
+    if sp_mesh is not None or use_flash:
         # shared head projection for both kernel paths — one site to
         # change if the projection ever grows biases or dtype casts
         q = _split_heads(hps, x_norm @ p["wq"])  # [B, T, nh, hd]
         k = _split_heads(hps, x_norm @ p["wk"])
         v = _split_heads(hps, x_norm @ p["wv"])
         sm_scale = _head_dim(hps) ** -0.5
-    if ring_mesh is not None:
-        fn = ra.make_ring_attention(ring_mesh, "sp")
+    if sp_mesh is not None:
+        fn = ra.make_sp_attention(sp_mesh, hps.sp_attention, "sp")
         return _merge_heads(fn(q, k, v, pad_mask, sm_scale)) @ p["wo"]
     if use_flash:
         from jax.experimental.pallas.ops.tpu import flash_attention as fa

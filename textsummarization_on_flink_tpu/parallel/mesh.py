@@ -273,6 +273,11 @@ def validate_divisibility(hps: HParams, params: Optional[PyTree] = None,
     if hps.sp > 1 and hps.max_enc_steps % hps.sp != 0:
         raise ValueError(f"sequence-parallel axis sp={hps.sp} must divide "
                          f"max_enc_steps={hps.max_enc_steps}")
+    if hps.sp > 1 and hps.sp_attention == "ulysses" \
+            and hps.num_heads % hps.sp != 0:
+        raise ValueError(
+            f"sp_attention=ulysses re-shards heads over sp: sp={hps.sp} "
+            f"must divide num_heads={hps.num_heads}")
     if hps.tp > 1 and hps.model_family == "transformer":
         if hps.num_heads % hps.tp != 0:
             raise ValueError(
@@ -281,12 +286,12 @@ def validate_divisibility(hps: HParams, params: Optional[PyTree] = None,
         if hps.ffn_width % hps.tp != 0:
             raise ValueError(f"tensor-parallel axis tp={hps.tp} must divide "
                              f"ffn_dim={hps.ffn_width}")
-        if hps.ring_attention:
+        if hps.sp_attention:
             raise ValueError(
-                "ring_attention with tp>1 is not supported: the ring's "
+                "sp_attention with tp>1 is not supported: the SP "
                 "shard_map replicates the head axis, which would silently "
                 "all-gather the Megatron-sharded q/k/v every layer — use "
-                "sp-only ring attention (tp=1) or tp without the ring")
+                "sp-only attention (tp=1) or tp without sp_attention")
 
 
 def make_sharded_beam_search(plan: MeshPlan,
@@ -315,8 +320,8 @@ def make_sharded_beam_search(plan: MeshPlan,
     def search(p, arrays):
         return beam_search._search_batch(p, hps, arrays)
 
-    # mesh context so the encoder's ring attention engages in serving too
-    # (a model trained with --ring_attention because [T,T] doesn't fit one
+    # mesh context so the encoder's sp attention engages in serving too
+    # (a model trained with --sp_attention because [T,T] doesn't fit one
     # device must not fall back to full attention at decode time)
     search = _with_mesh_context(plan, search)
     return jax.jit(search, in_shardings=(param_sh, batch_sh),

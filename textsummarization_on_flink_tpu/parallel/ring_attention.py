@@ -17,8 +17,8 @@ padding keys are masked with -1e30 before the max so a block of pure
 padding contributes exp(-1e30 - m) = 0.
 
 Used by the transformer family (models/transformer.py) when
-``hps.ring_attention`` is set and the encoder runs under an sp>1 mesh;
-exposed standalone for tests and reuse.
+``hps.sp_attention`` selects 'ring' (or 'ulysses', below) and the encoder
+runs under an sp>1 mesh; exposed standalone for tests and reuse.
 """
 
 from __future__ import annotations
@@ -101,11 +101,54 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp"):
     [B, T, nh, hd] sharded (or shardable) as P(None, sp) on T; mask
     [B, T].  Output matches q's global shape.
     """
-    def fn(q, k, v, mask, sm_scale):
-        return ring_self_attention(q, k, v, mask, axis_name, sm_scale)
+    return make_sp_attention(mesh, "ring", axis_name)
 
-    # keep the batch axis dp-sharded when the mesh has a dp axis (each dp
-    # group runs its own independent ring); heads stay replicated
+
+# --------------------------------------------------------------------------
+# Ulysses (all-to-all) sequence parallelism — the other standard SP layout
+# --------------------------------------------------------------------------
+
+def ulysses_self_attention(q: Array, k: Array, v: Array, kv_mask: Array,
+                           axis_name: str, sm_scale: float) -> Array:
+    """DeepSpeed-Ulysses-style SP: all-to-all re-shards q/k/v from
+    sequence-sharded [B, T/sp, nh, hd] to head-sharded [B, T, nh/sp, hd],
+    runs ordinary full attention per local head group, and all-to-alls
+    back.  Per call: three input all-to-alls (q/k/v) + one mask
+    all-gather + one output all-to-all, vs the ring's (sp-1) K/V
+    rotations — better when heads divide evenly and T is only moderately
+    long; the ring wins when T is so long that even one device's full-T
+    K/V working set is the constraint.  Requires nh % sp == 0."""
+    # [B, Tb, nh, hd] -> [B, T, nh/sp, hd]: split heads, concat sequence
+    qh = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    kh = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    mask_full = jax.lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+    logits = _block_attn(qh, kh, mask_full, sm_scale)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = probs * (mask_full[:, None, None, :] > 0)  # all-padding row -> 0
+    ctx = jnp.einsum("bnqk,bknd->bqnd", probs.astype(vh.dtype), vh)
+    # [B, T, nh/sp, hd] -> [B, T/sp, nh, hd]
+    return jax.lax.all_to_all(ctx, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True).astype(q.dtype)
+
+
+def make_sp_attention(mesh: Mesh, mode: str, axis_name: str = "sp"):
+    """shard_map-wrapped sequence-parallel attention over `mesh`'s sp
+    axis.  mode: 'ring' or 'ulysses'.  Global-array calling convention is
+    identical for both (q/k/v [B, T, nh, hd] T-sharded, mask [B, T])."""
+    if mode == "ring":
+        inner = ring_self_attention
+    elif mode == "ulysses":
+        inner = ulysses_self_attention
+    else:
+        raise ValueError(f"unknown sp_attention mode {mode!r}")
+
+    def fn(q, k, v, mask, sm_scale):
+        return inner(q, k, v, mask, axis_name, sm_scale)
+
     batch = "dp" if mesh.shape.get("dp", 1) > 1 else None
     spec4 = P(batch, axis_name, None, None)
     spec2 = P(batch, axis_name)
