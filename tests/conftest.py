@@ -20,3 +20,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# Build the native library at test time (a fresh clone + toolchain must
+# run the native-queue and native-chunk-reader tests; without a compiler
+# the native-parametrized tests skip via native_available()).
+try:
+    from textsummarization_on_flink_tpu.native import build as _native_build
+
+    _native_build.build()
+except Exception:  # noqa: BLE001 — optional dependency, skip-gated tests
+    pass

@@ -167,6 +167,46 @@ class TestChunks:
         with pytest.raises(AssertionError):
             next(example_generator(str(tmp_path / "nope_*.bin"), True))
 
+    def test_native_reader_parity(self, tmp_path, monkeypatch):
+        """The C++ chunk reader (native/chunkio.cpp) yields byte-identical
+        records to the pure-Python framing loop."""
+        from textsummarization_on_flink_tpu.data import chunks as chunks_mod
+        from textsummarization_on_flink_tpu.pipeline import bridge
+
+        if not bridge.native_available():
+            pytest.skip("native library not built")
+        path = str(tmp_path / "c.bin")
+        write_chunk_file(path, self._examples(50))
+        monkeypatch.setenv("TS_NATIVE_IO", "auto")
+        blobs = chunks_mod._native_read_blobs(path)
+        assert blobs is not None and len(blobs) == 50
+        native = list(read_chunk_file(path))
+        monkeypatch.setenv("TS_NATIVE_IO", "off")
+        assert chunks_mod._native_read_blobs(path) is None
+        assert native == list(read_chunk_file(path))
+
+    @pytest.mark.parametrize("io_mode", ["auto", "off"])
+    def test_reader_rejects_corrupt_framing(self, tmp_path, monkeypatch,
+                                            io_mode):
+        """Native and pure-Python readers raise the SAME error messages
+        on the same corrupt inputs."""
+        from textsummarization_on_flink_tpu.pipeline import bridge
+
+        if io_mode == "auto" and not bridge.native_available():
+            pytest.skip("native library not built")
+        monkeypatch.setenv("TS_NATIVE_IO", io_mode)
+        bad = str(tmp_path / "bad.bin")
+        for payload in (struct.pack("<q", 5) + b"ab",  # claims 5, has 2
+                        struct.pack("<q", -7) + b"ab"):  # negative length
+            with open(bad, "wb") as f:
+                f.write(payload)
+            with pytest.raises(ValueError, match="truncated record"):
+                list(read_chunk_file(bad))
+        with open(bad, "wb") as f:
+            f.write(b"\x01\x02\x03")  # not even a full prefix
+        with pytest.raises(ValueError, match="truncated length prefix"):
+            list(read_chunk_file(bad))
+
     def test_bin2txt(self, tmp_path):
         write_chunked(str(tmp_path / "t"), self._examples(3), chunk_size=10)
         out = str(tmp_path / "out.jsonl")

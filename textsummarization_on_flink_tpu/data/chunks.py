@@ -27,7 +27,69 @@ def write_chunk_file(path: str, examples: Iterable[Example]) -> int:
     return n
 
 
+# -4 (negative/oversized length prefix) reports as "truncated record" for
+# exact message parity with the pure-Python reader, which hits its length
+# mismatch on the same inputs
+_NATIVE_ERRORS = {-2: "truncated length prefix", -3: "truncated record",
+                  -4: "truncated record"}
+
+
+def _native_read_blobs(path: str) -> Optional[List[bytes]]:
+    """Read all record payloads via the C++ reader (native/chunkio.cpp):
+    one file slurp + framing validation in native code, one contiguous
+    payload buffer sliced here.  Returns None when the native library is
+    unavailable or TS_NATIVE_IO=off; raises ValueError on corrupt framing
+    (matching the pure-Python reader's errors)."""
+    import ctypes
+    import os
+
+    if os.environ.get("TS_NATIVE_IO", "auto").lower() in ("0", "off",
+                                                          "false"):
+        return None
+    from textsummarization_on_flink_tpu.pipeline import bridge
+
+    lib = bridge.NativeRecordQueue.load_library()
+    if lib is None or not hasattr(lib, "ts_chunk_read_file"):
+        return None
+    lib.ts_chunk_read_file.restype = ctypes.c_int
+    lib.ts_chunk_read_file.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_longlong)),
+        ctypes.POINTER(ctypes.c_longlong)]
+    lib.ts_chunk_free.restype = None
+    lib.ts_chunk_free.argtypes = [ctypes.POINTER(ctypes.c_char),
+                                  ctypes.POINTER(ctypes.c_longlong)]
+    buf = ctypes.POINTER(ctypes.c_char)()
+    offs = ctypes.POINTER(ctypes.c_longlong)()
+    n = ctypes.c_longlong()
+    rc = lib.ts_chunk_read_file(path.encode(), ctypes.byref(buf),
+                                ctypes.byref(offs), ctypes.byref(n))
+    if rc == -1:
+        raise OSError(f"native chunk reader cannot open {path}")
+    if rc == -5:
+        raise OSError(f"native chunk reader failed reading {path}")
+    if rc == -6:
+        raise MemoryError(f"native chunk reader allocation failed for {path}")
+    if rc != 0:
+        raise ValueError(
+            f"{_NATIVE_ERRORS.get(rc, f'error {rc}')} in {path}")
+    try:
+        count = n.value
+        base = ctypes.addressof(buf.contents) if count else 0
+        # slice each record straight from the native buffer — no
+        # whole-payload intermediate bytes object
+        return [ctypes.string_at(base + offs[i], offs[i + 1] - offs[i])
+                for i in range(count)]
+    finally:
+        lib.ts_chunk_free(buf, offs)
+
+
 def read_chunk_file(path: str) -> Iterator[Example]:
+    blobs = _native_read_blobs(path)
+    if blobs is not None:
+        for blob in blobs:
+            yield Example.parse(blob)
+        return
     with open(path, "rb") as f:
         while True:
             len_bytes = f.read(8)
@@ -36,6 +98,8 @@ def read_chunk_file(path: str) -> Iterator[Example]:
             if len(len_bytes) != 8:
                 raise ValueError(f"truncated length prefix in {path}")
             (str_len,) = struct.unpack("<q", len_bytes)
+            if str_len < 0:  # framing corruption (same report as native)
+                raise ValueError(f"truncated record in {path}")
             blob = f.read(str_len)
             if len(blob) != str_len:
                 raise ValueError(f"truncated record in {path}")

@@ -14,20 +14,29 @@ import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-SRC = os.path.join(HERE, "bridge.cpp")
+SRCS = [os.path.join(HERE, "bridge.cpp"), os.path.join(HERE, "chunkio.cpp")]
 OUT = os.path.join(HERE, "libtsbridge.so")
 
 
 def build(force: bool = False) -> str:
     if not force and os.path.exists(OUT) and \
-            os.path.getmtime(OUT) >= os.path.getmtime(SRC):
+            os.path.getmtime(OUT) >= max(os.path.getmtime(s) for s in SRCS):
         return OUT
     cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
     if cxx is None:
         raise RuntimeError("no C++ compiler found (need g++ or c++)")
+    # compile to a process-unique temp and rename into place: concurrent
+    # builders (parallel pytest runs on one checkout) must never let a
+    # loader see a half-written .so
+    tmp = f"{OUT}.{os.getpid()}.tmp"
     cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           SRC, "-o", OUT]
-    subprocess.run(cmd, check=True)
+           *SRCS, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True)
+        os.replace(tmp, OUT)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
     return OUT
 
 

@@ -1,0 +1,111 @@
+// Native chunk-file reader: the data plane's hot file-IO path.
+//
+// The on-disk format is the reference's length-prefixed tf.Example framing
+// (/root/reference/src/main/python/pointer-generator/data.py:108-141):
+// <8-byte little-endian signed length><payload> repeated.  The reference
+// parsed these inside TensorFlow's C++ runtime; the rebuild's equivalent
+// reads and validates the framing natively in ONE pass — a single file
+// slurp plus an offsets table — and hands Python a contiguous payload
+// buffer to slice, replacing 2 read() calls + a struct.unpack per record.
+//
+// C ABI (ctypes-friendly, no C++ types across the boundary):
+//   ts_chunk_read_file(path, &buf, &offs, &n) -> 0 ok / negative error
+//     buf:  malloc'd concatenation of all payloads
+//     offs: malloc'd array of n+1 offsets (record i = buf[offs[i]:offs[i+1]])
+//   ts_chunk_free(buf, offs)
+//
+// Errors: -1 open failure, -2 truncated length prefix, -3 truncated
+// record, -4 negative/absurd record length (framing corruption),
+// -5 read failure, -6 allocation failure.  No C++ exception ever crosses
+// the C ABI (the body is wrapped; bad_alloc maps to -6).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+static int read_file_impl(const char* path, char** out_buf,
+                          long long** out_offs, long long* out_n) {
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return -1;
+  std::fseek(f, 0, SEEK_END);
+  long file_size = std::ftell(f);
+  if (file_size < 0) {
+    std::fclose(f);
+    return -5;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<char> raw(static_cast<size_t>(file_size));
+  if (file_size > 0 &&
+      std::fread(raw.data(), 1, raw.size(), f) != raw.size()) {
+    std::fclose(f);
+    return -5;
+  }
+  std::fclose(f);
+
+  // first pass: validate framing, collect offsets
+  std::vector<long long> offs;
+  offs.push_back(0);
+  long long payload_total = 0;
+  size_t pos = 0;
+  while (pos < raw.size()) {
+    if (raw.size() - pos < 8) return -2;  // truncated length prefix
+    int64_t len;
+    std::memcpy(&len, raw.data() + pos, 8);  // little-endian hosts only
+    pos += 8;
+    if (len < 0 || static_cast<uint64_t>(len) > raw.size()) return -4;
+    if (raw.size() - pos < static_cast<size_t>(len)) return -3;
+    pos += static_cast<size_t>(len);
+    payload_total += len;
+    offs.push_back(payload_total);
+  }
+  long long n = static_cast<long long>(offs.size()) - 1;
+
+  char* buf = static_cast<char*>(std::malloc(
+      payload_total > 0 ? static_cast<size_t>(payload_total) : 1));
+  long long* offs_out = static_cast<long long*>(
+      std::malloc(sizeof(long long) * offs.size()));
+  if (buf == nullptr || offs_out == nullptr) {
+    std::free(buf);
+    std::free(offs_out);
+    return -6;
+  }
+  // second pass: copy payloads contiguously
+  pos = 0;
+  long long cursor = 0;
+  while (pos < raw.size()) {
+    int64_t len;
+    std::memcpy(&len, raw.data() + pos, 8);
+    pos += 8;
+    std::memcpy(buf + cursor, raw.data() + pos, static_cast<size_t>(len));
+    pos += static_cast<size_t>(len);
+    cursor += len;
+  }
+  std::memcpy(offs_out, offs.data(), sizeof(long long) * offs.size());
+  *out_buf = buf;
+  *out_offs = offs_out;
+  *out_n = n;
+  return 0;
+}
+
+int ts_chunk_read_file(const char* path, char** out_buf,
+                       long long** out_offs, long long* out_n) {
+  *out_buf = nullptr;
+  *out_offs = nullptr;
+  *out_n = 0;
+  try {
+    return read_file_impl(path, out_buf, out_offs, out_n);
+  } catch (...) {  // bad_alloc on huge files etc. must not cross the ABI
+    return -6;
+  }
+}
+
+void ts_chunk_free(char* buf, long long* offs) {
+  std::free(buf);
+  std::free(offs);
+}
+
+}  // extern "C"
