@@ -30,12 +30,26 @@ Modes (BENCH_MODE):
                     the einsum formula (fwd+bwd) at T=BENCH_FLASH_T
                     (default 2048), head_dim 128.  TPU only.
 
-Env overrides: BENCH_STEPS (20), BENCH_WARMUP (3), BENCH_BATCH (16),
+Env overrides: BENCH_STEPS (20), BENCH_BATCH (16),
 BENCH_PRESET=tiny (smoke scale), BENCH_FAMILY=transformer (bench the
 second model family), BENCH_FLASH_T (flash-mode sequence length),
 BENCH_TIMEOUT (600s per attempt), BENCH_ATTEMPTS (2), BENCH_PLATFORM=cpu
 (force CPU child for smoke runs), BENCH_PEAK_TFLOPS (override the
 per-chip bf16 peak used for MFU).
+
+Timing methodology: the TPU is reached through a tunnel with a ~10s-100s
+of ms host<->device round trip, and `jax.block_until_ready` has been
+observed to return EARLY for donated/aliased buffers on the axon
+backend.  So (a) the only fence this file trusts is a D2H fetch of a
+scalar that data-depends on the timed computation, and (b) the train /
+attention / flash measurement loops run ON DEVICE (lax.scan /
+lax.fori_loop around the op, one dispatch for the whole loop, iterations
+chained through a tiny data-dependent carry so XLA cannot hoist the
+body).  decode keeps a host-side per-iteration loop — its p50/p99
+latency samples need individual timings, so each sample includes one
+dispatch — with the measured fetch cost subtracted per sample.  The
+fetch cost on a ready buffer (`tunnel_rtt_ms`, reported in the JSON) is
+subtracted from each wall-clock window.
 """
 
 from __future__ import annotations
@@ -201,6 +215,30 @@ def _device_info():
                  "device": getattr(dev, "device_kind", str(dev))}
 
 
+def _fence(x) -> float:
+    """D2H fetch of one scalar — the only reliable execution fence over
+    the tunneled backend (see module docstring)."""
+    import jax
+
+    return float(np.asarray(jax.device_get(x)).ravel()[0])
+
+
+def _tunnel_rtt() -> float:
+    """Cost of one fence on an already-materialized buffer: the pure
+    host<->device round trip, to subtract from timed windows."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.device_put(jnp.float32(0.0))
+    _fence(x)
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _fence(x)
+        samples.append(time.perf_counter() - t0)
+    return min(samples)
+
+
 # --------------------------------------------------------------------------
 # children
 # --------------------------------------------------------------------------
@@ -225,6 +263,8 @@ def _preset_overrides() -> dict:
 
 
 def bench_train() -> None:
+    import functools
+
     import jax
 
     from textsummarization_on_flink_tpu.config import HParams
@@ -232,28 +272,33 @@ def bench_train() -> None:
     from __graft_entry__ import _example_arrays
 
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     batch = int(os.environ.get("BENCH_BATCH", "16"))
 
     hps = HParams(batch_size=batch, compute_dtype="bfloat16",
                   **_preset_overrides())
 
     state = trainer_lib.init_train_state(hps, hps.vocab_size, seed=0)
-    step_fn = jax.jit(trainer_lib.make_train_step(hps), donate_argnums=0)
+    step_fn = trainer_lib.make_train_step(hps)
     arrays = _example_arrays(hps, np.random.RandomState(0))
     arrays = jax.device_put(arrays)
 
-    for _ in range(warmup):
-        state, metrics = step_fn(state, arrays)
-    jax.block_until_ready(state.params)
+    def k_steps(state, arrays, k):
+        def body(s, _):
+            s, m = step_fn(s, arrays)
+            return s, m.loss
+        state, losses = jax.lax.scan(body, state, None, length=k)
+        return state, losses[-1]
+
+    run = jax.jit(functools.partial(k_steps, k=steps), donate_argnums=0)
+    state, loss0 = run(state, arrays)   # compile + warm (steps real steps)
+    _fence(loss0)
+    rtt = _tunnel_rtt()
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, arrays)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    state, loss_last = run(state, arrays)
+    loss = _fence(loss_last)
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
 
-    loss = float(metrics.loss)
     if not np.isfinite(loss):
         print(json.dumps({"metric": "train_samples_per_sec", "value": 0.0,
                           "unit": "samples/s", "vs_baseline": 0.0,
@@ -282,6 +327,8 @@ def bench_train() -> None:
         "peak_tflops": (peak / 1e12 if peak else None),
         "loss": round(loss, 4),
         "model_family": hps.model_family,
+        "tunnel_rtt_ms": round(rtt * 1e3, 2),
+        "timing": f"on-device lax.scan of {steps} steps, scalar-fetch fence",
     }
     rec.update(info)
     print(json.dumps(rec))
@@ -310,19 +357,22 @@ def bench_decode() -> None:
     arrays = jax.device_put(arrays)
 
     out = beam_search.run_beam_search_jit(params, hps, arrays)  # compile
-    jax.block_until_ready(out.tokens)
+    np.asarray(jax.device_get(out.length))
+    rtt = _tunnel_rtt()
     lat = []
     tokens = 0
     t_total = 0.0
     for _ in range(iters):
         t0 = time.perf_counter()
         out = beam_search.run_beam_search_jit(params, hps, arrays)
-        jax.block_until_ready(out.tokens)
-        dt = time.perf_counter() - t0
+        # fetching the lengths (data-dependent on the whole while_loop) is
+        # the fence; subtract the measured tunnel round trip
+        lengths = np.asarray(jax.device_get(out.length))
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
         lat.append(dt / batch)
         t_total += dt
         # length includes START (beam_search.py:57-58); generated = len-1
-        tokens += int(np.sum(np.asarray(out.length) - 1))
+        tokens += int(np.sum(lengths - 1))
     lat.sort()
     p50 = lat[len(lat) // 2]
     p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
@@ -336,6 +386,7 @@ def bench_decode() -> None:
         "tokens_per_sec": round(tokens / t_total, 1),
         "beam_size": hps.beam_size,
         "batch": batch,
+        "tunnel_rtt_ms": round(rtt * 1e3, 2),
     }
     rec.update(info)
     print(json.dumps(rec))
@@ -365,14 +416,27 @@ def bench_attention() -> None:
         wc = rng.randn(D).astype(np.float32)
         return tuple(jax.device_put(x) for x in (es, ef, mask, df, cov, v, wc))
 
+    rtt = _tunnel_rtt()
+
     def timed(fn, args):
-        out = fn(*args)
-        jax.block_until_ready(out)
+        """iters calls chained ON DEVICE: one fori_loop dispatch, each
+        iteration's dec_feats perturbed by a tiny carry computed from the
+        previous context so XLA cannot hoist the loop body."""
+        es, ef, mask, df, cov, v, wc = args
+
+        @jax.jit
+        def run_many():
+            def body(i, carry):
+                ctx, _ = fn(es, ef, mask, df + carry, cov, v, wc)
+                return ctx[:1, :1] * 1e-30
+            return jax.lax.fori_loop(0, iters, body,
+                                     jnp.zeros((1, 1), jnp.float32))
+
+        _fence(run_many())  # compile + warm
         t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / iters, out
+        out = run_many()
+        _fence(out)
+        return max(time.perf_counter() - t0 - rtt, 1e-9) / iters
 
     results = {}
     speedups = []
@@ -400,8 +464,8 @@ def bench_attention() -> None:
                 "error": f"pallas/xla mismatch at {name}: "
                          f"ctx {ctx_err} attn {attn_err}"}))
             sys.exit(1)
-        t_xla, _ = timed(xla, args)
-        t_pal, _ = timed(kern, args)
+        t_xla = timed(xla, args)
+        t_pal = timed(kern, args)
         results[name] = {
             "xla_us": round(t_xla * 1e6, 1),
             "pallas_us": round(t_pal * 1e6, 1),
@@ -418,6 +482,8 @@ def bench_attention() -> None:
         "vs_baseline": round(speedups[0], 3),
         "interpret_mode": not on_tpu,
         "scales": results,
+        "tunnel_rtt_ms": round(rtt * 1e3, 2),
+        "timing": f"on-device fori_loop of {iters} iters, carry-chained",
     }
     rec.update(info)
     print(json.dumps(rec))
@@ -444,20 +510,18 @@ def bench_flash() -> None:
     lens = rng.randint(T // 2, T + 1, size=(B,))
     mask = jnp.asarray((np.arange(T)[None] < lens[:, None]), jnp.float32)
 
+    def f(x):
+        out = tfm._self_attention(hps, p, x, mask, causal=False)
+        # mask the LOSS: padding-query rows legitimately differ between
+        # the two paths and must not leak gradient into the real rows
+        # being compared
+        return jnp.sum((out * mask[:, :, None]) ** 2)
+
     def run(flag):
         os.environ["TS_FLASH"] = flag
-
-        def fwd_bwd(x):
-            def f(x):
-                out = tfm._self_attention(hps, p, x, mask, causal=False)
-                # mask the LOSS: padding-query rows legitimately differ
-                # between the two paths and must not leak gradient into
-                # the real rows being compared
-                return jnp.sum((out * mask[:, :, None]) ** 2)
-            return jax.grad(f)(x)
         # compile NOW, while the env flag is set — jit traces lazily and
         # _use_flash reads TS_FLASH at trace time
-        return jax.jit(fwd_bwd).lower(x).compile()
+        return jax.jit(lambda x: jax.grad(f)(x)).lower(x).compile()
 
     f_xla, f_flash = run("off"), run("on")
     g0 = jax.block_until_ready(f_xla(x))
@@ -475,15 +539,30 @@ def bench_flash() -> None:
                                    f"(scale {scale})"}))
         sys.exit(1)
 
-    def timed(fn):
-        fn(x).block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(x)
-        out.block_until_ready()
-        return (time.perf_counter() - t0) / iters
+    rtt = _tunnel_rtt()
 
-    t_xla, t_flash = timed(f_xla), timed(f_flash)
+    def timed(flag):
+        """iters fwd+bwd passes of the same `f` chained on device; the
+        input is perturbed by a carry from the previous gradient so XLA
+        cannot hoist the body.  Traced+compiled while TS_FLASH is set
+        (read at trace time)."""
+        os.environ["TS_FLASH"] = flag
+
+        @jax.jit
+        def run_many(x):
+            def body(i, carry):
+                g = jax.grad(f)(x + carry)
+                return g[:1, :1, :1] * 1e-30
+            return jax.lax.fori_loop(0, iters, body,
+                                     jnp.zeros((1, 1, 1), jnp.float32))
+
+        _fence(run_many(x))  # compile + warm, flag still set
+        t0 = time.perf_counter()
+        out = run_many(x)
+        _fence(out)
+        return max(time.perf_counter() - t0 - rtt, 1e-9) / iters
+
+    t_xla, t_flash = timed("off"), timed("on")
     _, info = _device_info()
     rec = {
         "metric": "flash_attention_speedup_vs_xla",
@@ -493,6 +572,7 @@ def bench_flash() -> None:
         "xla_ms": round(t_xla * 1e3, 3),
         "flash_ms": round(t_flash * 1e3, 3),
         "T": T, "head_dim": 128, "max_grad_err": err,
+        "tunnel_rtt_ms": round(rtt * 1e3, 2),
     }
     rec.update(info)
     print(json.dumps(rec))

@@ -57,25 +57,36 @@ def _pad_to(x: Array, axis: int, mult: int, value: float = 0.0) -> Array:
 
 def _kernel(es_ref, ef_ref, mask_ref, df_ref, cov_ref, v_ref, wc_ref,
             ctx_ref, attn_ref, *, use_coverage: bool):
-    """One batch row: es/ef [1, T, D], mask/cov [1, T], df/v/wc [1, D]."""
-    ef = ef_ref[0]  # [T, D]
-    df = df_ref[0]  # [D]
-    feats = ef + df[None, :]
+    """One batch row: es/ef [1, T, D]; mask/cov/attn [1, T, 1];
+    df/ctx [1, 1, D]; v/wc [1, D].
+
+    Shapes are chosen for the Mosaic TPU block-mapping rule: every block's
+    trailing two dims are either (8, 128)-aligned or span the whole array
+    dim, so per-row [T, 1] columns and [1, D] rows are legal while plain
+    [1, T] per-row slices of a [B, T] array are not.
+    """
+    ef = ef_ref[0]                       # [T, D]
+    feats = ef + df_ref[0]               # + [1, D]
     if use_coverage:
-        feats = feats + cov_ref[0][:, None] * wc_ref[0][None, :]
-    e = jnp.sum(v_ref[0][None, :] * jnp.tanh(feats), axis=-1)  # [T]
-    mask = mask_ref[0]
+        feats = feats + cov_ref[0] * wc_ref[...]   # [T, 1] * [1, D]
+    e = jnp.sum(v_ref[...] * jnp.tanh(feats), axis=-1,
+                keepdims=True)           # [T, 1]
+    mask = mask_ref[0]                   # [T, 1]
     e = jnp.where(mask > 0, e, NEG)
     m = jnp.max(e)
-    p = jnp.exp(e - m) * (mask > 0)  # exp(NEG-m) could be denormal; zero it
+    p = jnp.where(mask > 0, jnp.exp(e - m), 0.0)
     l = jnp.sum(p)
     # fully-masked row (empty streamed article): l=0 would give NaN via
     # 0/0 and poison p_gen/final_dist; clamp -> zero attention instead
-    a = p / jnp.maximum(l, 1e-30)
-    attn_ref[0, :] = a
-    # context: [1, T] @ [T, D] on the MXU
-    ctx_ref[0, :] = jnp.dot(a[None, :], es_ref[0],
-                            preferred_element_type=jnp.float32)[0]
+    a = p / jnp.maximum(l, 1e-30)        # [T, 1]
+    attn_ref[0] = a
+    # context: aᵀ[1, T] @ es [T, D] on the MXU (contraction over dim 0);
+    # HIGHEST precision keeps full f32 (the matvec is a sliver of the
+    # kernel's work; default bf16 passes cost ~1e-2 absolute ctx error)
+    ctx_ref[0] = jax.lax.dot_general(
+        a, es_ref[0], (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
 
 
 def _attention_xla(enc_states, enc_feats, enc_mask, dec_feats, coverage,
@@ -110,7 +121,6 @@ def _attention_pallas(enc_states, enc_feats, enc_mask, dec_feats, coverage,
     wcp = _pad_to(w_c[None, :], 1, _LANE)[0]
     Tp, Dp = es.shape[1], es.shape[2]
 
-    row = lambda b: (b, 0)
     row3 = lambda b: (b, 0, 0)
     rep = lambda b: (0, 0)
     ctx, attn = pl.pallas_call(
@@ -119,37 +129,39 @@ def _attention_pallas(enc_states, enc_feats, enc_mask, dec_feats, coverage,
         in_specs=[
             pl.BlockSpec((1, Tp, Dp), row3, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, Tp, Dp), row3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Tp), row, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Dp), row, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Tp), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp, 1), row3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, Dp), row3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp, 1), row3, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, Dp), rep, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, Dp), rep, memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, Dp), row, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Tp), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, Dp), row3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tp, 1), row3, memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, Dp), jnp.float32),
-            jax.ShapeDtypeStruct((B, Tp), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1, Dp), jnp.float32),
+            jax.ShapeDtypeStruct((B, Tp, 1), jnp.float32),
         ],
         interpret=interpret,
     )(es.astype(jnp.float32), ef.astype(jnp.float32),
-      mask.astype(jnp.float32), df.astype(jnp.float32),
-      cov.astype(jnp.float32), vp[None].astype(jnp.float32),
+      mask.astype(jnp.float32)[:, :, None], df.astype(jnp.float32)[:, None, :],
+      cov.astype(jnp.float32)[:, :, None], vp[None].astype(jnp.float32),
       wcp[None].astype(jnp.float32))
-    return ctx[:, :D], attn[:, :T]
+    return ctx[:, 0, :D], attn[:, :T, 0]
 
 
 def _blocked_kernel(es_ref, ef_ref, mask_ref, df_ref, cov_ref, v_ref, wc_ref,
-                    ctx_ref, p_ref, mblk_ref, stat_ref,
-                    m_scr, l_scr, ctx_scr, *, use_coverage: bool):
+                    ctx_ref, e_ref, m_scr, l_scr, ctx_scr,
+                    *, use_coverage: bool):
     """Flash-style online-softmax block: grid (B, nT), T-blocks sequential.
 
-    Writes unnormalized p per block plus the running max it was computed
-    against (mblk) and final (m, l) stats; the wrapper applies the
-    correction  a_j = p_j * exp(mblk_j - m_fin) / l_fin  in XLA.  The
-    context accumulates in VMEM scratch with the usual rescaling.
+    The context accumulates in VMEM scratch with the usual running-max
+    rescaling and is normalized in-kernel at the last block.  The masked
+    energies stream out per block ([Tb, 1] columns); the wrapper recovers
+    the attention distribution from them with one cheap XLA softmax —
+    that keeps every output block TPU-legal (no per-block scalar stores)
+    while the [T, D] feats intermediate still never leaves VMEM.
     """
     import jax.experimental.pallas as pl
 
@@ -158,37 +170,35 @@ def _blocked_kernel(es_ref, ef_ref, mask_ref, df_ref, cov_ref, v_ref, wc_ref,
 
     @pl.when(j == 0)
     def _init():
-        m_scr[0] = NEG
-        l_scr[0] = 0.0
+        m_scr[0, 0] = NEG
+        l_scr[0, 0] = 0.0
         ctx_scr[:, :] = jnp.zeros_like(ctx_scr)
 
-    ef = ef_ref[0]  # [Tb, D]
-    df = df_ref[0]  # [D]
-    feats = ef + df[None, :]
+    ef = ef_ref[0]                       # [Tb, D]
+    feats = ef + df_ref[0]               # + [1, D]
     if use_coverage:
-        feats = feats + cov_ref[0][:, None] * wc_ref[0][None, :]
-    e = jnp.sum(v_ref[0][None, :] * jnp.tanh(feats), axis=-1)  # [Tb]
-    mask = mask_ref[0]
+        feats = feats + cov_ref[0] * wc_ref[...]   # [Tb, 1] * [1, D]
+    e = jnp.sum(v_ref[...] * jnp.tanh(feats), axis=-1,
+                keepdims=True)           # [Tb, 1]
+    mask = mask_ref[0]                   # [Tb, 1]
     e = jnp.where(mask > 0, e, NEG)
+    e_ref[0] = e
 
-    m_old = m_scr[0]
+    m_old = m_scr[0, 0]
     m_new = jnp.maximum(m_old, jnp.max(e))
     scale = jnp.exp(m_old - m_new)
-    p = jnp.exp(e - m_new) * (mask > 0)
-    l_scr[0] = l_scr[0] * scale + jnp.sum(p)
-    ctx_scr[:, :] = ctx_scr[:, :] * scale + jnp.dot(
-        p[None, :], es_ref[0], preferred_element_type=jnp.float32)
-    m_scr[0] = m_new
-
-    p_ref[0, :] = p
-    mblk_ref[0, 0] = m_new
+    p = jnp.where(mask > 0, jnp.exp(e - m_new), 0.0)   # [Tb, 1]
+    l_scr[0, 0] = l_scr[0, 0] * scale + jnp.sum(p)
+    ctx_scr[:, :] = ctx_scr[:, :] * scale + jax.lax.dot_general(
+        p, es_ref[0], (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    m_scr[0, 0] = m_new
 
     @pl.when(j == nT - 1)
     def _finish():
         # clamp like the simple kernel: fully-masked row has l=0
-        ctx_ref[0, :] = ctx_scr[0, :] / jnp.maximum(l_scr[0], 1e-30)
-        stat_ref[0, 0] = m_scr[0]
-        stat_ref[0, 1] = l_scr[0]
+        ctx_ref[0] = ctx_scr[:, :] / jnp.maximum(l_scr[0, 0], 1e-30)
 
 
 def _attention_pallas_blocked(enc_states, enc_feats, enc_mask, dec_feats,
@@ -210,49 +220,47 @@ def _attention_pallas_blocked(enc_states, enc_feats, enc_mask, dec_feats,
     Tp, Dp = es.shape[1], es.shape[2]
     nT = Tp // block_t
 
-    brow = lambda b, j: (b, 0)
+    brow3 = lambda b, j: (b, 0, 0)
     tb3 = lambda b, j: (b, j, 0)
-    tb2 = lambda b, j: (b, j)
     rep = lambda b, j: (0, 0)
-    ctx, p, mblk, stat = pl.pallas_call(
+    ctx, energies = pl.pallas_call(
         functools.partial(_blocked_kernel, use_coverage=use_coverage),
         grid=(B, nT),
         in_specs=[
             pl.BlockSpec((1, block_t, Dp), tb3, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_t, Dp), tb3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_t), tb2, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Dp), brow, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_t), tb2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_t, 1), tb3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, Dp), brow3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_t, 1), tb3, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, Dp), rep, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, Dp), rep, memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, Dp), brow, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_t), tb2, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), tb2, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 2), brow, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, Dp), brow3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_t, 1), tb3, memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, Dp), jnp.float32),
-            jax.ShapeDtypeStruct((B, Tp), jnp.float32),
-            jax.ShapeDtypeStruct((B, nT), jnp.float32),
-            jax.ShapeDtypeStruct((B, 2), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1, Dp), jnp.float32),
+            jax.ShapeDtypeStruct((B, Tp, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.SMEM((1,), jnp.float32),
-            pltpu.SMEM((1,), jnp.float32),
+            pltpu.SMEM((1, 1), jnp.float32),
+            pltpu.SMEM((1, 1), jnp.float32),
             pltpu.VMEM((1, Dp), jnp.float32),
         ],
         interpret=interpret,
     )(es.astype(jnp.float32), ef.astype(jnp.float32),
-      mask.astype(jnp.float32), df.astype(jnp.float32),
-      cov.astype(jnp.float32), vp.astype(jnp.float32),
+      mask.astype(jnp.float32)[:, :, None], df.astype(jnp.float32)[:, None, :],
+      cov.astype(jnp.float32)[:, :, None], vp.astype(jnp.float32),
       wcp.astype(jnp.float32))
-    m_fin = stat[:, 0:1]
-    l_fin = jnp.maximum(stat[:, 1:2], 1e-30)  # fully-masked row: l=0
-    corr = jnp.exp(jnp.repeat(mblk, block_t, axis=1) - m_fin)  # [B, Tp]
-    attn = p * corr / l_fin
-    return ctx[:, :D], attn[:, :T]
+    # attention from the streamed energies: one cheap [B, Tp] softmax in
+    # XLA (masked positions carry NEG so they exp to 0); the clamp keeps a
+    # fully-masked row at zero attention instead of NaN
+    e = energies[:, :, 0]
+    m_fin = jnp.max(e, axis=-1, keepdims=True)
+    p = jnp.where(mask > 0, jnp.exp(e - m_fin), 0.0)
+    attn = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    return ctx[:, 0, :D], attn[:, :T]
 
 
 # VMEM budget heuristic: two [T, D] f32 slices per row beyond this, stream
