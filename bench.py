@@ -356,7 +356,9 @@ def bench_decode() -> None:
               if not k.startswith(("dec_", "target_"))}
     arrays = jax.device_put(arrays)
 
-    out = beam_search.run_beam_search_jit(params, hps, arrays)  # compile
+    beam_loop = beam_search._loop_kind()  # TS_BEAM_LOOP env override
+    out = beam_search.run_beam_search_jit(params, hps, arrays,
+                                          loop=beam_loop)  # compile
     np.asarray(jax.device_get(out.length))
     rtt = _tunnel_rtt()
     lat = []
@@ -364,9 +366,10 @@ def bench_decode() -> None:
     t_total = 0.0
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = beam_search.run_beam_search_jit(params, hps, arrays)
-        # fetching the lengths (data-dependent on the whole while_loop) is
-        # the fence; subtract the measured tunnel round trip
+        out = beam_search.run_beam_search_jit(params, hps, arrays,
+                                              loop=beam_loop)
+        # fetching the lengths (data-dependent on the whole decode loop)
+        # is the fence; subtract the measured tunnel round trip
         lengths = np.asarray(jax.device_get(out.length))
         dt = max(time.perf_counter() - t0 - rtt, 1e-9)
         lat.append(dt / batch)
@@ -386,6 +389,7 @@ def bench_decode() -> None:
         "tokens_per_sec": round(tokens / t_total, 1),
         "beam_size": hps.beam_size,
         "batch": batch,
+        "beam_loop": beam_loop,
         "tunnel_rtt_ms": round(rtt * 1e3, 2),
     }
     rec.update(info)

@@ -163,6 +163,23 @@ def test_output_invariants(params):
             assert row[L:].sum() < 1e-6
 
 
+@pytest.mark.parametrize("coverage", [False, True])
+def test_scan_loop_matches_while_loop(params, coverage):
+    """TS_BEAM_LOOP=scan (fixed trip count, masked updates — auto-picked
+    on RPC-proxied backends to dodge per-while-iteration host round
+    trips) must be token-exact with the early-exit while_loop."""
+    hps = HPS.replace(coverage=coverage)
+    arrays = make_arrays(hps, seed=5)
+    a = beam_search.run_beam_search_jit(params, hps, arrays, loop="while")
+    b = beam_search.run_beam_search_jit(params, hps, arrays, loop="scan")
+    np.testing.assert_array_equal(np.asarray(a.tokens), np.asarray(b.tokens))
+    np.testing.assert_array_equal(np.asarray(a.length), np.asarray(b.length))
+    np.testing.assert_allclose(np.asarray(a.avg_log_prob),
+                               np.asarray(b.avg_log_prob), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(a.attn_dists),
+                               np.asarray(b.attn_dists), atol=1e-6)
+
+
 def test_min_dec_steps_blocks_early_stop(params):
     # with min_dec_steps == max-1, any STOP before the horizon is discarded,
     # so results are either long or the live-beam fallback
