@@ -214,9 +214,21 @@ def _decoder_core(params: Params, hps: HParams, enc: EncoderOutput,
 
 def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
                   ) -> TrainOutput:
-    """Full training/eval forward pass: scan the decoder over T_dec steps,
-    computing the masked NLL and coverage loss in-scan (model.py:199-277
-    semantics; per-step [B, V] projection keeps HBM use flat)."""
+    """Full training/eval forward pass (model.py:199-277 semantics).
+
+    The decoder scan carries only the recurrent state; everything batched
+    over steps is hoisted out of it:
+      * the embedding half of input_linear runs as one [B, T, E] matmul
+        before the scan;
+      * the FLOP-dominant [H, V] output projection, its softmax, and the
+        NLL run AFTER the scan as one [T_dec, B, H] @ [H, V] matmul —
+        per-step projection feeds the MXU M=B rows per 128-row tile
+        (~12% fill at the reference batch); hoisted it is M=T_dec*B;
+      * the coverage loss is the closed-form exclusive prefix sum of the
+        stacked attention outputs (loss_ops.coverage_loss).
+    Memory note: the hoisted scores tensor is [T_dec, B, V] f32 (~320 MB
+    at reference scale), the price of the MXU-shaped matmul.
+    """
     B = arrays["enc_batch"].shape[0]
     T_enc = arrays["enc_batch"].shape[1]
     enc = encode(params, hps, arrays["enc_batch"], arrays["enc_lens"],
@@ -224,56 +236,45 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
     emb_dec = params["embedding"][arrays["dec_batch"]]  # [B, T_dec, E]
     w = params["output_projection"]["w"]
     v = params["output_projection"]["v"]
-    # hoist the embedding half of input_linear out of the scan (one big
-    # MXU matmul); the context half is added per step in-scan
     ip = params["decoder"]["input_linear"]
     E = emb_dec.shape[-1]
     emb_proj = emb_dec @ ip["kernel"][:E] + ip["bias"]  # [B, T_dec, E]
     k_ctx = ip["kernel"][E:]
 
-    def step(carry, xs):
+    def step(carry, emb_proj_t):
         state, context, coverage = carry
-        emb_proj_t, target, ext_ids_unused = xs
         x = emb_proj_t + context @ k_ctx
         res = _decoder_core(params, hps, enc, arrays["enc_padding_mask"],
                             state, context, coverage, x)
-        vocab_scores = _proj(hps, res["output"], w) + v  # [B, V]
-        vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
-        if hps.pointer_gen:
-            gold = loss_ops.gold_mixture_prob(
-                vocab_dist, res["attn_dist"], res["p_gen"], target,
-                arrays["enc_batch_extend_vocab"])
-            nll = -jnp.log(gold)
-        else:
-            nll = -jnp.take_along_axis(
-                jax.nn.log_softmax(vocab_scores, axis=-1),
-                target[:, None], axis=1)[:, 0]
-        covloss = jnp.sum(jnp.minimum(res["attn_dist"], coverage), axis=1)
         return ((res["state"], res["context"], res["coverage"]),
-                (nll, covloss, res["attn_dist"], res["p_gen"]))
+                (res["output"], res["attn_dist"], res["p_gen"]))
 
     D = enc.enc_states.shape[-1]
     init = (enc.dec_in_state, jnp.zeros((B, D), jnp.float32),
             jnp.zeros((B, T_enc), jnp.float32))
-    xs = (jnp.swapaxes(emb_proj, 0, 1),
-          jnp.swapaxes(arrays["target_batch"], 0, 1),
-          jnp.swapaxes(arrays["target_batch"], 0, 1))
-    _, (nlls, covlosses, attn_dists, p_gens) = jax.lax.scan(step, init, xs)
+    _, (outputs, attn_dists, p_gens) = jax.lax.scan(
+        step, init, jnp.swapaxes(emb_proj, 0, 1))
 
+    # hoisted projection + loss over all steps at once
+    scores = _proj(hps, outputs, w) + v  # [T_dec, B, V]
     dec_mask = arrays["dec_padding_mask"]
-    nlls = jnp.swapaxes(nlls, 0, 1)  # [B, T_dec]
-    covlosses = jnp.swapaxes(covlosses, 0, 1)
+    targets_t = jnp.swapaxes(arrays["target_batch"], 0, 1)  # [T_dec, B]
     if hps.pointer_gen:
-        loss = loss_ops.mask_and_avg(nlls, dec_mask)
+        gold = loss_ops.gold_mixture_prob_from_scores(
+            scores, attn_dists, p_gens, targets_t,
+            arrays["enc_batch_extend_vocab"])
+        loss = loss_ops.pointer_nll(jnp.swapaxes(gold, 0, 1), dec_mask)
     else:
-        loss = jnp.sum(nlls * dec_mask) / jnp.sum(dec_mask)
+        loss = loss_ops.softmax_cross_entropy_baseline(
+            jnp.swapaxes(scores, 0, 1), arrays["target_batch"], dec_mask)
+    attn_b = jnp.swapaxes(attn_dists, 0, 1)  # [B, T_dec, T_enc]
     if hps.coverage:
-        cov_loss = loss_ops.mask_and_avg(covlosses, dec_mask)
+        cov_loss = loss_ops.coverage_loss(attn_b, dec_mask)
     else:
         cov_loss = jnp.zeros(())
     total = loss + hps.cov_loss_wt * cov_loss
     return TrainOutput(loss=loss, coverage_loss=cov_loss, total_loss=total,
-                       attn_dists=jnp.swapaxes(attn_dists, 0, 1),
+                       attn_dists=attn_b,
                        p_gens=jnp.swapaxes(p_gens, 0, 1))
 
 

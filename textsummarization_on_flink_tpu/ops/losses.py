@@ -44,15 +44,43 @@ def gold_mixture_prob(vocab_dist: Array, attn_dist: Array, p_gen: Array,
     vocab_dist: [B, V] softmax over the fixed vocab;
     attn_dist: [B, T_enc]; p_gen: [B]; target: [B] extended-vocab ids;
     enc_batch_extend_vocab: [B, T_enc] extended-vocab ids per source pos.
+
+    Thin wrapper over gold_mixture_prob_from_scores (log-probabilities ARE
+    scores whose logsumexp is 0), keeping one source of truth for the
+    mixture math.
     """
-    V = vocab_dist.shape[-1]
-    in_vocab = target < V
-    safe_t = jnp.where(in_vocab, target, 0)
-    gen_prob = jnp.take_along_axis(vocab_dist, safe_t[:, None], axis=1)[:, 0]
-    gen_prob = jnp.where(in_vocab, gen_prob, 0.0)
+    return gold_mixture_prob_from_scores(
+        jnp.log(vocab_dist)[None], attn_dist[None], p_gen[None],
+        target[None], enc_batch_extend_vocab)[0]
+
+
+def gold_mixture_prob_from_scores(vocab_scores: Array, attn_dists: Array,
+                                  p_gens: Array, targets: Array,
+                                  enc_batch_extend_vocab: Array) -> Array:
+    """Gold-target probability for ALL steps at once, from raw vocab
+    scores.
+
+    vocab_scores: [T, B, V]; attn_dists: [T, B, T_enc]; p_gens: [T, B];
+    targets: [T, B] extended ids; enc_batch_extend_vocab: [B, T_enc].
+    Returns [T, B].
+
+    Same mixture as gold_mixture_prob with the vocab softmax written as
+    exp(score_target - logsumexp(scores)), so callers can hoist the
+    [H, V] projection out of their decoder scan into one
+    [T*B, H] @ [H, V] matmul — a per-step M=B slice starves the MXU's
+    128-row tiles; M=T*B fills them.
+    """
+    V = vocab_scores.shape[-1]
+    lse = jax.scipy.special.logsumexp(vocab_scores, axis=-1)  # [T, B]
+    in_vocab = targets < V
+    safe_t = jnp.where(in_vocab, targets, 0)
+    score_t = jnp.take_along_axis(
+        vocab_scores, safe_t[..., None], axis=-1)[..., 0]
+    gen_prob = jnp.where(in_vocab, jnp.exp(score_t - lse), 0.0)
     copy_prob = jnp.sum(
-        attn_dist * (enc_batch_extend_vocab == target[:, None]), axis=1)
-    return p_gen * gen_prob + (1.0 - p_gen) * copy_prob
+        attn_dists * (enc_batch_extend_vocab[None] == targets[..., None]),
+        axis=-1)
+    return p_gens * gen_prob + (1.0 - p_gens) * copy_prob
 
 
 def pointer_nll(gold_probs: Array, dec_padding_mask: Array,

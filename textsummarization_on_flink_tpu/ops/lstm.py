@@ -16,8 +16,11 @@ outputs beyond each sequence's length are zeros and the carried state
 freezes at the last valid step; the backward direction runs over the
 length-aware reversed sequence (reverse_sequence semantics).
 
-Everything here is jit/scan-based: one `lax.scan` per direction, batched
-matmuls on the MXU, no Python-level step loops.
+Everything here is jit/scan-based — no Python-level step loops.  BOTH
+encoder directions share ONE `lax.scan` (the backward one consumes the
+reversed sequence), with the input half of each fused kernel hoisted out
+of the scan as a whole-sequence matmul; only the recurrent `h @ k_h`
+half is sequential.
 """
 
 from __future__ import annotations
@@ -31,6 +34,17 @@ Array = jax.Array
 LSTMState = Tuple[Array, Array]  # (c, h)
 
 
+def _apply_gates(z: Array, c: Array, forget_bias: float,
+                 ) -> Tuple[Array, Array]:
+    """TF1 LSTMCell gate math on pre-activations z = [x, h] @ kernel + b
+    (gate order [i, j, f, o], see module docstring).  Returns (c', h')."""
+    i, j, f, o = jnp.split(z, 4, axis=-1)
+    new_c = c * jax.nn.sigmoid(f + forget_bias) \
+        + jax.nn.sigmoid(i) * jnp.tanh(j)
+    new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+    return new_c, new_h
+
+
 def lstm_cell(params: Dict[str, Array], x: Array, state: LSTMState,
               forget_bias: float = 1.0) -> Tuple[Array, LSTMState]:
     """One LSTM step. x: [B, I]; state: ([B, H], [B, H])."""
@@ -41,49 +55,8 @@ def lstm_cell(params: Dict[str, Array], x: Array, state: LSTMState,
     kernel = params["kernel"].astype(x.dtype)
     bias = params["bias"].astype(x.dtype)
     z = jnp.concatenate([x, h], axis=-1) @ kernel + bias
-    i, j, f, o = jnp.split(z, 4, axis=-1)
-    new_c = c * jax.nn.sigmoid(f + forget_bias) + jax.nn.sigmoid(i) * jnp.tanh(j)
-    new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+    new_c, new_h = _apply_gates(z, c, forget_bias)
     return new_h, (new_c, new_h)
-
-
-def unidirectional_scan(params: Dict[str, Array], inputs: Array, mask: Array,
-                        init_state: LSTMState,
-                        forget_bias: float = 1.0) -> Tuple[Array, LSTMState]:
-    """Run an LSTM over time with dynamic_rnn length semantics.
-
-    inputs: [B, T, I]; mask: [B, T] (1.0 for valid steps).
-    Returns outputs [B, T, H] (zeroed past each length) and the final state
-    (frozen at each sequence's last valid step).
-
-    MXU layout: the input half of the fused TF1 kernel is applied to the
-    WHOLE sequence as one [B, T, I] @ [I, 4H] matmul before the scan (a
-    single large tile instead of T skinny ones); only the recurrent
-    h @ k_h half stays inside the scan.  Same math as lstm_cell — the
-    fused z = [x, h] @ kernel splits exactly into x @ k_x + h @ k_h.
-    """
-    I = inputs.shape[-1]
-    kernel = params["kernel"].astype(inputs.dtype)
-    bias = params["bias"].astype(inputs.dtype)
-    k_x, k_h = kernel[:I], kernel[I:]
-    x_proj = inputs @ k_x + bias  # [B, T, 4H], hoisted out of the scan
-
-    def step(state, xm):
-        xp, m = xm
-        m = m[:, None]
-        c, h = state
-        z = xp + h @ k_h
-        i, j, f, o = jnp.split(z, 4, axis=-1)
-        new_c = c * jax.nn.sigmoid(f + forget_bias) \
-            + jax.nn.sigmoid(i) * jnp.tanh(j)
-        new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
-        c = jnp.where(m > 0, new_c, c)
-        h = jnp.where(m > 0, new_h, h)
-        return (c, h), new_h * m
-
-    xs = (jnp.swapaxes(x_proj, 0, 1), jnp.swapaxes(mask, 0, 1))
-    final_state, outs = jax.lax.scan(step, init_state, xs)
-    return jnp.swapaxes(outs, 0, 1), final_state
 
 
 def reverse_sequence(x: Array, lens: Array) -> Array:
@@ -98,16 +71,51 @@ def reverse_sequence(x: Array, lens: Array) -> Array:
 
 def bidirectional_encoder(fw_params: Dict[str, Array], bw_params: Dict[str, Array],
                           inputs: Array, lens: Array, mask: Array,
+                          forget_bias: float = 1.0,
                           ) -> Tuple[Array, LSTMState, LSTMState]:
     """bidirectional_dynamic_rnn parity (model.py:76-94).
 
     Returns (outputs [B, T, 2H] fw||bw concat, fw_state, bw_state).
+
+    Both directions run in ONE scan: the backward direction consumes the
+    length-aware reversed sequence, so stacking (fw, bw) on a leading
+    direction axis makes each step a [2, B, H] x [2, H, 4H] batched
+    matmul.  That halves the sequential depth versus two consecutive
+    scans — at LSTM sizes the scan is latency-bound, so depth is the
+    cost that matters — while the per-direction kernels stay separate
+    (and TF1-checkpoint-loadable) via the batched einsum.
     """
     B = inputs.shape[0]
     H = fw_params["kernel"].shape[1] // 4
-    zero = (jnp.zeros((B, H), inputs.dtype), jnp.zeros((B, H), inputs.dtype))
-    fw_out, fw_state = unidirectional_scan(fw_params, inputs, mask, zero)
+    I = inputs.shape[-1]
     rev_inputs = reverse_sequence(inputs, lens)
-    bw_out_rev, bw_state = unidirectional_scan(bw_params, rev_inputs, mask, zero)
-    bw_out = reverse_sequence(bw_out_rev, lens)
+    inputs2 = jnp.stack([inputs, rev_inputs])  # [2, B, T, I]
+    kernel2 = jnp.stack([fw_params["kernel"], bw_params["kernel"]]
+                        ).astype(inputs.dtype)  # [2, I+H, 4H]
+    bias2 = jnp.stack([fw_params["bias"], bw_params["bias"]]
+                      ).astype(inputs.dtype)  # [2, 4H]
+    k_x2, k_h2 = kernel2[:, :I], kernel2[:, I:]
+    # input half hoisted out of the scan, both directions in one matmul
+    x_proj2 = jnp.einsum("dbti,dif->dbtf", inputs2, k_x2) \
+        + bias2[:, None, None, :]  # [2, B, T, 4H]
+
+    def step(state, xm):
+        xp, m = xm  # [2, B, 4H], [B]
+        m = m[None, :, None]
+        c, h = state  # each [2, B, H]
+        z = xp + jnp.einsum("dbh,dhf->dbf", h, k_h2)
+        new_c, new_h = _apply_gates(z, c, forget_bias)
+        c = jnp.where(m > 0, new_c, c)
+        h = jnp.where(m > 0, new_h, h)
+        return (c, h), new_h * m
+
+    zero2 = (jnp.zeros((2, B, H), inputs.dtype),
+             jnp.zeros((2, B, H), inputs.dtype))
+    xs = (jnp.moveaxis(x_proj2, 2, 0), jnp.swapaxes(mask, 0, 1))
+    (final_c, final_h), outs = jax.lax.scan(step, zero2, xs)
+    outs = jnp.moveaxis(outs, 0, 2)  # [2, B, T, H]
+    fw_out = outs[0]
+    bw_out = reverse_sequence(outs[1], lens)
+    fw_state = (final_c[0], final_h[0])
+    bw_state = (final_c[1], final_h[1])
     return jnp.concatenate([fw_out, bw_out], axis=-1), fw_state, bw_state
