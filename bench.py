@@ -504,6 +504,16 @@ def bench_flash() -> None:
     from textsummarization_on_flink_tpu.models import transformer as tfm
 
     iters = int(os.environ.get("BENCH_STEPS", "30"))
+    if jax.default_backend() != "tpu":
+        # _use_flash refuses non-TPU backends even when forced (the
+        # kernel has no CPU/GPU lowering), so both timed paths would be
+        # the einsum formula and the ratio would be meaningless ~1.0
+        print(json.dumps({"metric": "flash_attention_speedup_vs_xla",
+                          "value": 0.0, "unit": "x", "vs_baseline": 0.0,
+                          "retryable": False,
+                          "error": "flash mode requires a TPU backend "
+                                   f"(have {jax.default_backend()!r})"}))
+        sys.exit(1)
     B, T = 4, int(os.environ.get("BENCH_FLASH_T", "2048"))
     hps = HParams(model_family="transformer", hidden_dim=1024, num_heads=8,
                   max_enc_steps=T, batch_size=B)

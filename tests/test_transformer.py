@@ -125,15 +125,20 @@ def test_kv_cache_matches_teacher_forcing(setup):
 
 
 def test_flash_gating(monkeypatch):
-    """Flash self-attention only engages on lane-aligned long shapes;
-    TS_FLASH=off always wins; auto requires a TPU backend."""
+    """Flash self-attention only engages on lane-aligned long shapes AND
+    a TPU backend (the kernel has no CPU/GPU lowering); TS_FLASH=off
+    always wins; auto additionally requires T >= 1024."""
     hps_small = tiny_hps()  # hd=4 -> never aligned
     assert not tfm._use_flash(hps_small, 400)
     hps_big = tiny_hps(hidden_dim=1024, num_heads=8)  # hd=128
     monkeypatch.setenv("TS_FLASH", "on")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert tfm._use_flash(hps_big, 1024)
     assert not tfm._use_flash(hps_big, 400)  # T not lane-aligned
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert not tfm._use_flash(hps_big, 1024)  # forced, but no TPU
     monkeypatch.setenv("TS_FLASH", "off")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert not tfm._use_flash(hps_big, 1024)
     monkeypatch.setenv("TS_FLASH", "auto")
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
@@ -161,10 +166,14 @@ def test_flash_branch_matches_einsum_interpret(monkeypatch):
     monkeypatch.setenv("TS_FLASH", "off")
     ref = tfm._self_attention(hps, p, x, mask, causal=False)
     monkeypatch.setenv("TS_FLASH", "on")
+    # _use_flash requires a TPU backend even when forced (the kernel has
+    # no CPU lowering); interpret mode stands in for the hardware here
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert tfm._use_flash(hps, T)
     with pltpu.force_tpu_interpret_mode():
         got = tfm._self_attention(hps, p, x, mask, causal=False)
         got_causal = tfm._self_attention(hps, p, x, None, causal=True)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     monkeypatch.setenv("TS_FLASH", "off")
     ref_causal = tfm._self_attention(hps, p, x, None, causal=True)
     real = np.asarray(mask)[:, :, None] > 0

@@ -179,9 +179,11 @@ def _use_flash(hps: HParams, T: int) -> bool:
     """Route self-attention through the Pallas TPU flash kernel when it
     pays off: long sequences at head widths the kernel tiles natively
     (the [B, nh, T, T] score tensor never hits HBM).  TS_FLASH=on forces
-    it, =off disables; auto requires TPU + T>=1024 + lane-aligned shapes.
-    Cross-attention never uses it — its probabilities ARE the copy
-    distribution and must be materialized anyway."""
+    it on eligible shapes, =off disables; auto additionally requires
+    T>=1024.  Either way the kernel is TPU-only (its Mosaic lowering has
+    no CPU/GPU path), so a non-TPU backend always falls through to the
+    einsum formula.  Cross-attention never uses it — its probabilities
+    ARE the copy distribution and must be materialized anyway."""
     import os
 
     env = os.environ.get("TS_FLASH", "auto").lower()
@@ -189,12 +191,12 @@ def _use_flash(hps: HParams, T: int) -> bool:
         return False
     hd = _head_dim(hps)
     aligned = T % 128 == 0 and hd % 128 == 0
-    if env in ("1", "on", "true"):
-        return aligned
     try:
         on_tpu = jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover
         on_tpu = False
+    if env in ("1", "on", "true"):
+        return aligned and on_tpu
     return on_tpu and aligned and T >= 1024
 
 
