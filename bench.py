@@ -29,6 +29,10 @@ Modes (BENCH_MODE):
   flash           — A/B the transformer's Pallas flash self-attention vs
                     the einsum formula (fwd+bwd) at T=BENCH_FLASH_T
                     (default 2048), head_dim 128.  TPU only.
+  input           — host-side input-pipeline throughput: the threaded
+                    bucketing Batcher packing synthetic reference-scale
+                    articles into static-shape batches (no TPU; compare
+                    against the device's train samples/s).
 
 Env overrides: BENCH_STEPS (20), BENCH_BATCH (16),
 BENCH_PRESET=tiny (smoke scale), BENCH_FAMILY=transformer (bench the
@@ -67,6 +71,7 @@ _METRIC_BY_MODE = {
     "decode": "beam_decode_p50_latency_per_article",
     "attention": "attention_pallas_speedup_vs_xla",
     "flash": "flash_attention_speedup_vs_xla",
+    "input": "input_pipeline_samples_per_sec",
 }
 
 
@@ -80,6 +85,9 @@ def _child_env() -> dict:
     env = dict(os.environ)
     env["TS_BENCH_CHILD"] = "1"
     repo_root = os.path.dirname(os.path.abspath(__file__))
+    if env.get("BENCH_MODE") == "input":
+        # host-only mode: never let a down TPU tunnel hang the child
+        env["BENCH_PLATFORM"] = "cpu"
     if env.get("BENCH_PLATFORM", "").lower() == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("JAX_PLATFORM_NAME", None)
@@ -592,6 +600,77 @@ def bench_flash() -> None:
     print(json.dumps(rec))
 
 
+def bench_input() -> None:
+    """BENCH_MODE=input: host-side input-pipeline throughput — the
+    threaded bucketing Batcher (16+4 producer threads, reference
+    batcher.py:252-253 parity) packing a synthetic chunked CNN/DM-scale
+    dataset into static-shape train batches.  No TPU involved; the
+    number to compare against is the device's train samples/s (the
+    pipeline must exceed it to keep the chip busy)."""
+    import shutil
+    import tempfile
+
+    from textsummarization_on_flink_tpu.config import HParams
+    from textsummarization_on_flink_tpu.data import TFExample, Vocab
+    from textsummarization_on_flink_tpu.data.batcher import Batcher
+    from textsummarization_on_flink_tpu.data.chunks import write_chunked
+
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
+    hps = HParams(batch_size=batch, **_preset_overrides())
+
+    rng = np.random.RandomState(0)
+    words = [f"w{i}" for i in range(2000)]
+    vocab = Vocab(words=words)
+    tmp = tempfile.mkdtemp(prefix="bench_input_")
+    try:
+        exs = []
+        for _ in range(512):
+            art_len = rng.randint(hps.max_enc_steps // 2,
+                                  hps.max_enc_steps + 100)
+            art = " ".join(rng.choice(words, size=art_len))
+            abs_len = rng.randint(hps.max_dec_steps // 2, hps.max_dec_steps)
+            abstract = "<s> " + " ".join(rng.choice(words, size=abs_len)) \
+                + " . </s>"
+            exs.append(TFExample()
+                       .set_bytes("article", art.encode())
+                       .set_bytes("abstract", abstract.encode()))
+        write_chunked(os.path.join(tmp, "train"), exs, chunk_size=128)
+
+        b = Batcher(os.path.join(tmp, "train_*.bin"), vocab, hps,
+                    single_pass=False)
+        b.next_batch()  # wait for the producer threads to come up
+        # the batch queue holds up to 100 pre-built batches; timing a
+        # drain of that backlog would measure Queue.get, not pipeline
+        # throughput.  Pull until the queue is momentarily empty so the
+        # clock starts from ~zero backlog, then count batches produced
+        # during a fixed window (consumed ≈ produced from an empty
+        # start — any end-of-window backlog is uncounted, so the number
+        # errs low, never high).
+        drained = 0
+        while b.queued_batches() > 0 and drained < 300:
+            b.next_batch()
+            drained += 1
+        seconds = float(os.environ.get("BENCH_SECONDS", "3"))
+        t0 = time.perf_counter()
+        n_batches = 0
+        while time.perf_counter() - t0 < seconds:
+            b.next_batch()
+            n_batches += 1
+        dt = time.perf_counter() - t0
+        rate = n_batches * batch / dt
+        print(json.dumps({
+            "metric": "input_pipeline_samples_per_sec",
+            "value": round(rate, 1),
+            "unit": "samples/s",
+            "vs_baseline": round(rate / 13.5, 2),  # K40m train anchor
+            "batch": batch,
+            "batches_timed": n_batches,
+            "note": "host-only; must exceed device train samples/s",
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def child_main() -> None:
     mode = os.environ.get("BENCH_MODE", "train")
     if mode == "decode":
@@ -600,6 +679,8 @@ def child_main() -> None:
         bench_attention()
     elif mode == "flash":
         bench_flash()
+    elif mode == "input":
+        bench_input()
     elif mode == "train":
         bench_train()
     else:
@@ -607,7 +688,7 @@ def child_main() -> None:
                           "unit": "n/a", "vs_baseline": 0.0,
                           "retryable": False,
                           "error": f"unknown BENCH_MODE={mode!r} "
-                                   f"(train/decode/attention/flash)"}))
+                                   f"(train/decode/attention/flash/input)"}))
         sys.exit(2)
 
 

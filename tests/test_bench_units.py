@@ -66,6 +66,39 @@ def test_peak_flops_known_device_kinds(monkeypatch):
     assert bench.peak_flops_for(Dev("Banana9000")) is None
 
 
+def test_input_mode_child_env_forces_cpu(monkeypatch):
+    """BENCH_MODE=input is host-only; the supervisor must scrub the env
+    so a down TPU tunnel can never hang the child's jax import."""
+    monkeypatch.setenv("BENCH_MODE", "input")
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("PYTHONPATH", "/root/.axon_site")
+    env = bench._child_env()
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert ".axon_site" not in env.get("PYTHONPATH", "")
+
+
+def test_input_bench_runs_on_host(tmp_path):
+    """The input-pipeline bench end to end (tiny scale): one JSON line
+    with a positive samples/s.  Runs in a subprocess like the real
+    supervisor does — bench_input's Batcher threads are daemon threads
+    reaped by process exit, and must not leak into this pytest
+    process."""
+    import json
+    import subprocess
+
+    env = dict(os.environ)
+    env.update(TS_BENCH_CHILD="1", BENCH_MODE="input", BENCH_PRESET="tiny",
+               BENCH_SECONDS="0.5", BENCH_BATCH="4", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "input_pipeline_samples_per_sec"
+    assert rec["value"] > 0
+
+
 def test_preset_overrides_family(monkeypatch):
     monkeypatch.setenv("BENCH_PRESET", "tiny")
     monkeypatch.setenv("BENCH_FAMILY", "transformer")

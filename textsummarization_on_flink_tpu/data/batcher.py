@@ -98,6 +98,12 @@ class Batcher:
             self._watch_thread.start()
 
     # -- consumer API --
+    def queued_batches(self) -> int:
+        """Approximate count of ready batches in the output queue (for
+        consumers that want to distinguish backlog from live production,
+        e.g. throughput measurement)."""
+        return self._batch_queue.qsize()
+
     def raise_if_failed(self) -> None:
         """Re-raise the first producer-thread failure in the consumer."""
         err = self._fill_error
