@@ -1,0 +1,74 @@
+"""End-to-end streaming pipeline example: fit -> serve in ONE process.
+
+The reference needed two sequential Flink jobs for this (one-TFUtils-call-
+per-job blocker, doc/Flink-AI-Extended Integration Report.md:9,260-282;
+App.java:202-207 runs startTraining then startInference).  Here the same
+flow — train from a stream of (uuid, article, summary, reference) rows,
+persist the model as config-only JSON, then serve summaries from a second
+stream with per-record flushing — is one script, mirroring
+TensorFlowTest.testInferenceAfterTraining (TensorFlowTest.java:68-91) on
+the same 8 synthetic rows (TensorFlowTest.java:204-217).
+
+Run on anything (CPU works; tiny model so it finishes in ~a minute):
+
+    python examples/serving_pipeline.py
+
+Swap CollectionSource/CollectionSink for KafkaSource/KafkaSink (topics
+flink_train / flink_input / flink_output) to reproduce the reference's
+Kafka topology, or SocketSource for testInferenceFromSocket.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import tempfile  # noqa: E402
+
+from textsummarization_on_flink_tpu.config import HParams  # noqa: E402
+from textsummarization_on_flink_tpu.data.vocab import Vocab  # noqa: E402
+from textsummarization_on_flink_tpu.pipeline import app as app_lib  # noqa: E402
+from textsummarization_on_flink_tpu.pipeline.io import (  # noqa: E402
+    CollectionSink,
+    CollectionSource,
+)
+
+
+def synthetic_rows(n=8):
+    """TensorFlowTest.createArticleData(): (uuid, article, summary,
+    reference) rows, uuid-i / 'article i.'."""
+    return [(f"uuid-{i}", f"article {i} .", "", f"reference {i} .")
+            for i in range(n)]
+
+
+def main():
+    log_root = tempfile.mkdtemp(prefix="serving_pipeline_")
+    vocab = Vocab(words=["article", "reference", ".", "0", "1", "2", "3",
+                         "4", "5", "6", "7"])
+    tiny = dict(hidden_dim=16, emb_dim=8, vocab_size=vocab.size(),
+                max_enc_steps=16, max_dec_steps=6, beam_size=2,
+                min_dec_steps=1, max_oov_buckets=4, batch_size=2,
+                log_root=log_root, exp_name="serve")
+    # num_steps=0 = train until the bounded stream is exhausted — the 8
+    # rows at batch 2 yield exactly 4 steps (the reference's
+    # testInferenceAfterTraining trains on the same bounded stream)
+    app = app_lib.App(
+        train_hps=HParams(mode="train", num_steps=0, **tiny),
+        inference_hps=HParams(mode="decode", **tiny),
+        vocab=vocab)
+
+    model_json = app.start_training(CollectionSource(synthetic_rows()))
+    print(f"model JSON (config-only, weights live in {log_root}):")
+    print(f"  {model_json[:120]}...")
+
+    sink = app.start_inference(model_json,
+                               source=CollectionSource(synthetic_rows(4)),
+                               sink=CollectionSink())
+    for uuid, article, summary, reference in sink.rows:
+        print(f"  {uuid}: {article!r} -> {summary!r}")
+    assert len(sink.rows) == 4
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
