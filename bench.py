@@ -35,7 +35,8 @@ Modes (BENCH_MODE):
                     against the device's train samples/s).
 
 Env overrides: BENCH_STEPS (20), BENCH_BATCH (16),
-BENCH_PRESET=tiny (smoke scale), BENCH_FAMILY=transformer (bench the
+BENCH_PRESET=tiny|scaled (smoke scale / the BASELINE configs[3]
+hidden-512 enc-800 shape), BENCH_FAMILY=transformer (bench the
 second model family), BENCH_FLASH_T (flash-mode sequence length),
 BENCH_TIMEOUT (600s per attempt), BENCH_ATTEMPTS (2), BENCH_PLATFORM=cpu
 (force CPU child for smoke runs), BENCH_PEAK_TFLOPS (override the
@@ -253,18 +254,23 @@ def _tunnel_rtt() -> float:
 
 def _preset_overrides() -> dict:
     """BENCH_PRESET=tiny shrinks the model for smoke runs (full-scale
-    beam-search compiles take minutes on CPU); default is the reference
-    scale.  BENCH_FAMILY=transformer benches the second model family
-    (BART-class; 6+6 layers at hidden_dim width)."""
+    beam-search compiles take minutes on CPU); =scaled is the
+    BASELINE.json configs[3] long-input shape (hidden 512, enc 800);
+    default is the reference scale.  BENCH_FAMILY=transformer benches
+    the second model family (BART-class; 6+6 layers at hidden_dim
+    width)."""
     out = {}
     if os.environ.get("BENCH_PRESET") == "tiny":
         out.update(hidden_dim=16, emb_dim=8, vocab_size=200,
                    max_enc_steps=32, max_dec_steps=8, beam_size=2,
                    min_dec_steps=1, max_oov_buckets=8)
+    elif os.environ.get("BENCH_PRESET") == "scaled":
+        out.update(hidden_dim=512, max_enc_steps=800)
     family = os.environ.get("BENCH_FAMILY", "")
     if family:
         out["model_family"] = family
-        if family == "transformer" and "hidden_dim" in out:
+        if family == "transformer" \
+                and os.environ.get("BENCH_PRESET") == "tiny":
             out["num_heads"] = 4  # tiny preset: 16/4 heads
             out["enc_layers"] = out["dec_layers"] = 2
     return out

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Capture the full benchmark sweep on the current backend into one JSONL
+# file (default BENCH_ALL.jsonl).  Each line is bench.py's single JSON
+# record plus a "run" tag.  Used to (re)populate BASELINE.md's measured
+# table whenever the TPU tunnel is healthy:
+#
+#     scripts/bench_all.sh [out.jsonl]
+#
+# Runs: train at reference batch 16 (Pallas on AND off — picks the
+# attention default), train at batch 64, train scaled (hidden 512 /
+# enc 800), transformer-family train, decode latency, attention +
+# flash kernel A/Bs, host input pipeline.
+set -uo pipefail
+
+OUT="${1:-BENCH_ALL.jsonl}"
+cd "$(dirname "$0")/.."
+
+run() {
+  local tag="$1"; shift
+  echo "== $tag" >&2
+  local line
+  line="$(env "$@" python bench.py 2>/dev/null | tail -1)"
+  if [ -n "$line" ]; then
+    printf '%s\n' "$line" | python -c "
+import json,sys
+rec = json.loads(sys.stdin.read()); rec['run'] = '$tag'
+print(json.dumps(rec))" >> "$OUT"
+  else
+    echo "{\"run\": \"$tag\", \"error\": \"no output\"}" >> "$OUT"
+  fi
+}
+
+run train_b16            BENCH_MODE=train
+run train_b16_no_pallas  BENCH_MODE=train TS_PALLAS=off
+run train_b64            BENCH_MODE=train BENCH_BATCH=64
+run train_scaled         BENCH_MODE=train BENCH_PRESET=scaled
+run train_transformer    BENCH_MODE=train BENCH_FAMILY=transformer
+run decode_b4            BENCH_MODE=decode
+run attention_ab         BENCH_MODE=attention
+run flash_ab             BENCH_MODE=flash
+run input_pipeline       BENCH_MODE=input
+
+echo "wrote $(wc -l < "$OUT") records to $OUT" >&2
