@@ -13,7 +13,9 @@
 set -uo pipefail
 
 OUT="${1:-BENCH_ALL.jsonl}"
+case "$OUT" in /*) ;; *) OUT="$PWD/$OUT" ;; esac  # resolve before the cd
 cd "$(dirname "$0")/.."
+: > "$OUT"  # truncate: reruns must not accumulate stale records
 
 run() {
   local tag="$1"; shift

@@ -221,3 +221,44 @@ def test_rouge_anchor_harness_end_to_end(source, tmp_path):
     # ROUGE_results.txt written in the decode dir (decode.py:280-301 parity)
     found = list((tmp_path / "rouge_run").rglob("ROUGE_results.txt"))
     assert found
+
+
+def test_rouge_anchor_real_artifacts_gated(tmp_path):
+    """Full ROUGE-vs-anchor run against the REAL pretrained bundle and
+    CNN/DM test split (VERDICT r1 #4's gated slow test).  The artifacts
+    come from scripts/download_data.sh + scripts/download_model.sh; on
+    hosts without them (e.g. zero-egress CI) this skips.  With them, the
+    imported checkpoint must land within 0.5 ROUGE-L F1 of the See et
+    al. anchor on a 256-article slice.  Opt in with TS_RUN_ANCHOR=1 —
+    the decode takes a long time, so artifact presence alone must not
+    drag it into a routine pytest run."""
+    import glob as glob_mod
+    import importlib.util
+
+    if os.environ.get("TS_RUN_ANCHOR") != "1":
+        pytest.skip("set TS_RUN_ANCHOR=1 to run the slow ROUGE anchor test")
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    bundle = os.path.join(repo, "log", "pretrained_model_tf1.2.1",
+                          "model-238410")
+    data = os.path.join(repo, "data", "cnn-dailymail", "finished_files",
+                        "chunked", "test_*")
+    vocab = os.path.join(repo, "data", "cnn-dailymail", "finished_files",
+                         "vocab")
+    if not (os.path.exists(bundle + ".index") and glob_mod.glob(data)
+            and os.path.exists(vocab)):
+        pytest.skip("pretrained bundle / CNN-DM artifacts not on disk "
+                    "(run scripts/download_data.sh + download_model.sh)")
+
+    spec = importlib.util.spec_from_file_location(
+        "rouge_anchor", os.path.join(repo, "scripts", "rouge_anchor.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main([
+        "--bundle", bundle,
+        "--data", data,
+        "--vocab", vocab,
+        "--log_root", str(tmp_path / "anchor_run"),
+        "--max_articles", "256",
+        "--tolerance", "0.5",
+    ])
+    assert rc == 0
