@@ -124,6 +124,33 @@ def test_kv_cache_matches_teacher_forcing(setup):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_bf16_blocks_compute_in_bf16(setup):
+    """bf16 activations against f32 master params must NOT silently
+    promote the layer matmuls back to f32 (half the MXU's bf16 rate):
+    _mha and _ffn_block cast params to the activation dtype, so their
+    outputs stay bf16."""
+    hps, vocab, batch, state = setup
+    rng = np.random.RandomState(0)
+    layer = state.params["encoder"]["layers"][0]
+    x = jnp.asarray(rng.randn(2, 8, hps.hidden_dim) * 0.1, jnp.bfloat16)
+    mask = jnp.ones((2, 1, 8), jnp.float32)
+    out, probs = tfm._mha(hps, layer["self_attn"], x, x, mask)
+    assert out.dtype == jnp.bfloat16
+    assert probs.dtype == jnp.float32  # copy distribution stays f32
+    assert tfm._ffn_block(layer["ffn"], x).dtype == jnp.bfloat16
+
+
+def test_bf16_forward_train_close_to_f32(setup):
+    hps, vocab, batch, state = setup
+    arrays = batch.as_arrays()
+    out32 = tfm.forward_train(state.params, hps, arrays)
+    out16 = tfm.forward_train(state.params,
+                              hps.replace(compute_dtype="bfloat16"), arrays)
+    assert np.isfinite(float(out16.loss))
+    np.testing.assert_allclose(float(out16.loss), float(out32.loss),
+                               rtol=3e-2)
+
+
 def test_flash_gating(monkeypatch):
     """Flash self-attention only engages on lane-aligned long shapes AND
     a TPU backend (the kernel has no CPU/GPU lowering); TS_FLASH=off

@@ -152,11 +152,17 @@ def _mha(hps: HParams, p: Dict[str, Array], q_in: Array, kv_in: Array,
     [..., Tq, Tk] (1 = attend).  Returns (output [..., Tq, H],
     head-averaged probabilities [..., Tq, Tk] in f32).
     """
-    q = _split_heads(hps, q_in @ p["wq"])  # [..., Tq, nh, hd]
-    k = _split_heads(hps, kv_in @ p["wk"])
-    v = _split_heads(hps, kv_in @ p["wv"])
+    # compute in the activation dtype: master params are f32, cast per
+    # use (bf16 activations @ f32 weights would silently PROMOTE the
+    # matmul back to f32 — half the MXU's bf16 rate); accumulation stays
+    # f32 via preferred_element_type
+    dt = q_in.dtype
+    q = _split_heads(hps, q_in @ p["wq"].astype(dt))  # [..., Tq, nh, hd]
+    k = _split_heads(hps, kv_in @ p["wk"].astype(dt))
+    v = _split_heads(hps, kv_in @ p["wv"].astype(dt))
     scale = _head_dim(hps) ** -0.5
-    logits = jnp.einsum("...qnd,...knd->...nqk", q, k).astype(jnp.float32)
+    logits = jnp.einsum("...qnd,...knd->...nqk", q, k,
+                        preferred_element_type=jnp.float32)
     logits = logits * scale
     neg = jnp.asarray(-1e30, jnp.float32)
     logits = jnp.where(mask[..., None, :, :] > 0, logits, neg)
@@ -166,13 +172,16 @@ def _mha(hps: HParams, p: Dict[str, Array], q_in: Array, kv_in: Array,
     # masked_softmax semantics in ops/attention.py)
     any_key = jnp.sum(mask[..., None, :, :], axis=-1, keepdims=True) > 0
     probs = jnp.where(any_key, probs, 0.0)
-    ctx = jnp.einsum("...nqk,...knd->...qnd", probs.astype(v.dtype), v)
-    out = _merge_heads(ctx) @ p["wo"]
+    ctx = jnp.einsum("...nqk,...knd->...qnd", probs.astype(dt), v,
+                     preferred_element_type=jnp.float32).astype(dt)
+    out = _merge_heads(ctx) @ p["wo"].astype(dt)
     return out, jnp.mean(probs, axis=-3)  # head-avg [..., Tq, Tk]
 
 
 def _ffn_block(p: Dict[str, Array], x: Array) -> Array:
-    return jax.nn.gelu(x @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+    dt = x.dtype  # see _mha: keep the matmuls in the activation dtype
+    h = jax.nn.gelu(x @ p["w1"].astype(dt) + p["b1"].astype(dt))
+    return h @ p["w2"].astype(dt) + p["b2"].astype(dt)
 
 
 def _use_flash(hps: HParams, T: int) -> bool:
@@ -219,14 +228,22 @@ def _self_attention(hps: HParams, p: Dict[str, Array], x_norm: Array,
     use_flash = sp_mesh is None and _use_flash(hps, T)
     if sp_mesh is not None or use_flash:
         # shared head projection for both kernel paths — one site to
-        # change if the projection ever grows biases or dtype casts
-        q = _split_heads(hps, x_norm @ p["wq"])  # [B, T, nh, hd]
-        k = _split_heads(hps, x_norm @ p["wk"])
-        v = _split_heads(hps, x_norm @ p["wv"])
+        # change if the projection ever grows biases or dtype casts;
+        # params cast to the activation dtype like _mha
+        dt = x_norm.dtype
+        q = _split_heads(hps, x_norm @ p["wq"].astype(dt))  # [B, T, nh, hd]
+        k = _split_heads(hps, x_norm @ p["wk"].astype(dt))
+        v = _split_heads(hps, x_norm @ p["wv"].astype(dt))
         sm_scale = _head_dim(hps) ** -0.5
     if sp_mesh is not None:
+        # the ring/ulysses kernels accumulate logits and context in the
+        # input dtype (ring_attention.py) — hand them f32 q/k/v so the
+        # module invariant 'attention logits, softmax run in f32' holds
+        # on the sp path too; the projections above still ran at bf16
         fn = ra.make_sp_attention(sp_mesh, hps.sp_attention, "sp")
-        return _merge_heads(fn(q, k, v, pad_mask, sm_scale)) @ p["wo"]
+        ctx = _merge_heads(fn(q.astype(jnp.float32), k.astype(jnp.float32),
+                              v.astype(jnp.float32), pad_mask, sm_scale))
+        return (ctx @ p["wo"].astype(ctx.dtype)).astype(dt)
     if use_flash:
         from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
@@ -240,7 +257,8 @@ def _self_attention(hps: HParams, p: Dict[str, Array], x_norm: Array,
             seg = fa.SegmentIds(q=ids, kv=ids)
         out = fa.flash_attention(q, k, v, segment_ids=seg, causal=causal,
                                  sm_scale=sm_scale)
-        return _merge_heads(jnp.swapaxes(out, 1, 2)) @ p["wo"]
+        ctx = _merge_heads(jnp.swapaxes(out, 1, 2))
+        return ctx @ p["wo"].astype(ctx.dtype)
     if causal:
         mask = jnp.tril(jnp.ones((T, T), jnp.float32))[None]
     else:
@@ -375,11 +393,12 @@ def beam_encode(params: Params, hps: HParams, arrays: Dict[str, Array],
     x = _embed_enc(params, hps, arrays["enc_batch"])
     enc_out = _encoder_stack(params, hps, x, arrays["enc_padding_mask"])
     enc_c = pg._cast(hps, enc_out)
+    dt = enc_c.dtype  # keep the K/V precompute matmuls in the cast dtype
     ks, vs = [], []
     for layer in params["decoder"]["layers"]:
         p = layer["cross_attn"]
-        ks.append(_split_heads(hps, enc_c @ p["wk"]))
-        vs.append(_split_heads(hps, enc_c @ p["wv"]))
+        ks.append(_split_heads(hps, enc_c @ p["wk"].astype(dt)))
+        vs.append(_split_heads(hps, enc_c @ p["wv"].astype(dt)))
     return TransformerEncView(cross_k=jnp.stack(ks, axis=1),
                               cross_v=jnp.stack(vs, axis=1))
 
@@ -413,12 +432,14 @@ def beam_adapter(hps: HParams):
         pos_ok = (jnp.arange(T) <= t).astype(jnp.float32)  # [T]
         cache_k, cache_v = state["cache_k"], state["cache_v"]
         attn_dist = None
+        dt = y.dtype  # projections in the activation dtype (see _mha);
+        # the cache and softmaxes below deliberately stay f32
         for li, layer in enumerate(params["decoder"]["layers"]):
             p = layer["self_attn"]
             h_norm = _ln(layer["ln1"], y)
-            q = _split_heads(hps, h_norm @ p["wq"])  # [K, nh, hd]
-            k_new = _split_heads(hps, h_norm @ p["wk"])
-            v_new = _split_heads(hps, h_norm @ p["wv"])
+            q = _split_heads(hps, h_norm @ p["wq"].astype(dt))  # [K, nh, hd]
+            k_new = _split_heads(hps, h_norm @ p["wk"].astype(dt))
+            v_new = _split_heads(hps, h_norm @ p["wv"].astype(dt))
             cache_k = cache_k.at[:, li, t].set(k_new.astype(jnp.float32))
             cache_v = cache_v.at[:, li, t].set(v_new.astype(jnp.float32))
             kk = cache_k[:, li]  # [K, T, nh, hd]
@@ -428,10 +449,11 @@ def beam_adapter(hps: HParams):
             logits = jnp.where(pos_ok[None, None, :] > 0, logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
             ctx = jnp.einsum("knt,ktnd->knd", probs, vv)
-            y = y + _merge_heads(ctx).astype(y.dtype) @ p["wo"]
+            y = y + _merge_heads(ctx).astype(dt) @ p["wo"].astype(dt)
             # cross attention against the precomputed per-layer K/V
             cp = layer["cross_attn"]
-            qc = _split_heads(hps, _ln(layer["ln_cross"], y) @ cp["wq"])
+            qc = _split_heads(hps,
+                              _ln(layer["ln_cross"], y) @ cp["wq"].astype(dt))
             ck = enc_one.cross_k[li]  # [T_enc, nh, hd]
             cv = enc_one.cross_v[li]
             clogits = jnp.einsum("knd,tnd->knt", qc.astype(jnp.float32),
@@ -441,7 +463,7 @@ def beam_adapter(hps: HParams):
             any_key = jnp.sum(enc_mask) > 0
             cprobs = jnp.where(any_key, cprobs, 0.0)
             cctx = jnp.einsum("knt,tnd->knd", cprobs, cv.astype(jnp.float32))
-            cross_out = _merge_heads(cctx).astype(y.dtype) @ cp["wo"]
+            cross_out = _merge_heads(cctx).astype(dt) @ cp["wo"].astype(dt)
             y = y + cross_out
             y = y + _ffn_block(layer["ffn"], _ln(layer["ln2"], y))
             attn_dist = jnp.mean(cprobs, axis=1)  # [K, T_enc] head-avg
