@@ -286,3 +286,23 @@ class TestDecodeStep:
                                    jnp.asarray(attn), p_gen, ext_ids)
         assert fd.shape == (2, V + hps.max_oov_buckets)
         np.testing.assert_allclose(np.asarray(fd).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_bf16_forward_close_to_f32():
+    """compute_dtype=bfloat16 (encoder LSTM + output projection in bf16,
+    attention/decoder-state f32) must track the f32 loss closely."""
+    hps = hps_tiny(hidden_dim=8, emb_dim=6)
+    vocab = make_vocab()
+    batch = make_batch(hps, vocab)
+    hps = hps.replace(vocab_size=vocab.size())
+    params = pg.init_params(hps, vocab.size(), jax.random.PRNGKey(5))
+    arrays = batch.as_arrays()
+    out32 = pg.forward_train(params, hps, arrays)
+    out16 = pg.forward_train(params, hps.replace(compute_dtype="bfloat16"),
+                             arrays)
+    assert np.isfinite(float(out16.loss))
+    np.testing.assert_allclose(float(out16.loss), float(out32.loss),
+                               rtol=3e-2)
+    np.testing.assert_allclose(float(out16.coverage_loss),
+                               float(out32.coverage_loss), rtol=5e-2,
+                               atol=1e-3)

@@ -243,7 +243,9 @@ def _self_attention(hps: HParams, p: Dict[str, Array], x_norm: Array,
         fn = ra.make_sp_attention(sp_mesh, hps.sp_attention, "sp")
         ctx = _merge_heads(fn(q.astype(jnp.float32), k.astype(jnp.float32),
                               v.astype(jnp.float32), pad_mask, sm_scale))
-        return (ctx @ p["wo"].astype(ctx.dtype)).astype(dt)
+        # downcast the f32-accumulated context before the wo matmul, like
+        # _mha — else the projection runs at the MXU's f32 rate
+        return ctx.astype(dt) @ p["wo"].astype(dt)
     if use_flash:
         from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
