@@ -52,13 +52,16 @@ lax.fori_loop around the op, one dispatch for the whole loop, iterations
 chained through a tiny data-dependent carry so XLA cannot hoist the
 body).  decode keeps a host-side per-iteration loop — its p50/p99
 latency samples need individual timings, so each sample includes one
-dispatch — with the measured fetch cost subtracted per sample.  The
-fetch cost on a ready buffer (`tunnel_rtt_ms`, reported in the JSON) is
-subtracted from each wall-clock window.
+dispatch.  Decode reports RAW wall-clock percentiles as the headline
+(what a client of this backend observes; immune to RTT-estimate noise)
+plus RTT-corrected ones (`*_rtt_corrected_ms`, the device-side
+estimate) side by side; the fetch cost on a ready buffer is reported
+as `tunnel_rtt_ms`.
 """
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import subprocess
@@ -97,6 +100,96 @@ def _child_env() -> dict:
     return env
 
 
+def _config_fingerprint() -> dict:
+    """The config axes that distinguish one sweep row from another, as
+    seen from the environment.  Successful records embed this; the stale
+    fallback matches on it so e.g. a batch-64 record can never stand in
+    for the default batch-16 ask."""
+    mode = os.environ.get("BENCH_MODE", "train")
+    fp = {"mode": mode}
+    # a CPU smoke record must never stand in for a TPU ask (or vice
+    # versa); input mode is host-only by construction
+    if mode == "input":
+        fp["platform"] = "cpu"
+    else:
+        fp["platform"] = (os.environ.get("BENCH_PLATFORM", "").lower()
+                          or "tpu")
+    if mode in ("train", "decode"):
+        fp["batch"] = int(os.environ.get(
+            "BENCH_BATCH", "16" if mode == "train" else "4"))
+        fp["preset"] = os.environ.get("BENCH_PRESET", "ref") or "ref"
+        fp["family"] = (os.environ.get("BENCH_FAMILY", "")
+                        or "pointer_generator")
+        fp["pallas"] = os.environ.get("TS_PALLAS", "auto") or "auto"
+    if mode == "decode":
+        # while vs scan decode loops differ by ~1.4 ms/iteration on the
+        # tunneled backend — never cross-substitute their latencies
+        fp["beam_loop"] = os.environ.get("TS_BEAM_LOOP", "auto") or "auto"
+    elif mode == "flash":
+        fp["flash_t"] = int(os.environ.get("BENCH_FLASH_T", "2048"))
+    elif mode == "input":
+        fp["batch"] = int(os.environ.get("BENCH_BATCH", "16"))
+    return fp
+
+
+def _stale_fallback(metric: str, last_err: str) -> dict | None:
+    """When every live attempt TIMES OUT (tunnel down at capture time),
+    fall back to the newest matching record in BENCH_ALL.jsonl — a real
+    measurement taken earlier in the round — marked "stale": true with
+    its capture timestamp.  VERDICT r2 #1: the driver record must never
+    again be an empty error stub while real measurements exist on disk.
+    Only timeouts qualify: a crash/import error is a code regression and
+    must surface, not be papered over (see supervise())."""
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    path = os.environ.get("BENCH_STALE_FILE",
+                          os.path.join(repo_root, "BENCH_ALL.jsonl"))
+    if not os.path.exists(path):
+        return None
+    want = _config_fingerprint()
+    best = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict) or rec.get("metric") != metric \
+                        or "error" in rec or rec.get("stale"):
+                    continue
+                # exact fingerprint match only: a record that cannot
+                # prove its config (legacy, pre-fingerprint) must not
+                # stand in for any ask — run tags like "train_b64" all
+                # contain "train" and would cross-match configs
+                if rec.get("config_fingerprint") != want:
+                    continue
+                # the fingerprint records env INTENT; rec["platform"] is
+                # the backend the child actually measured on (a TPU ask
+                # can silently fall back to CPU when the plugin is
+                # missing).  CPU-ness must agree between ask and record.
+                measured = str(rec.get("platform", "")).lower()
+                if measured and ((measured == "cpu")
+                                 != (want["platform"] == "cpu")):
+                    continue
+                # newest match wins: file order == capture order (records
+                # are appended as they are measured), so the last match
+                # in the file is the newest regardless of whether older
+                # lines carry a captured_at field
+                best = rec
+    except OSError:
+        return None
+    if best is None:
+        return None
+    best = dict(best)
+    best["stale"] = True
+    best["stale_source"] = os.path.basename(path)
+    best["live_error"] = last_err
+    return best
+
+
 def supervise() -> None:
     mode = os.environ.get("BENCH_MODE", "train")
     metric = _METRIC_BY_MODE.get(mode, f"bench_{mode}")
@@ -107,6 +200,7 @@ def supervise() -> None:
     timeout = float(os.environ.get("BENCH_TIMEOUT", default_timeout))
     repo_root = os.path.dirname(os.path.abspath(__file__))
     last_err = "no attempts made"
+    all_timeouts = True  # stale fallback is for tunnel hangs ONLY
     for attempt in range(1, attempts + 1):
         try:
             proc = subprocess.run(
@@ -121,6 +215,7 @@ def supervise() -> None:
                         f"{timeout:.0f}s (TPU tunnel down?)")
             sys.stderr.write(f"[bench] {last_err}\n{out[-1500:]}\n")
             continue
+        all_timeouts = False
         # the child's LAST parseable JSON line with "metric" is the result
         result = None
         for line in (proc.stdout or "").splitlines():
@@ -133,6 +228,11 @@ def supervise() -> None:
                 if isinstance(obj, dict) and "metric" in obj:
                     result = obj
         if result is not None and "error" not in result:
+            result.setdefault(
+                "captured_at",
+                datetime.datetime.now(datetime.timezone.utc)
+                .strftime("%Y-%m-%dT%H:%M:%SZ"))
+            result.setdefault("config_fingerprint", _config_fingerprint())
             print(json.dumps(result))
             return
         last_err = (f"attempt {attempt}/{attempts}: child rc="
@@ -142,7 +242,20 @@ def supervise() -> None:
         sys.stderr.write(f"[bench] {last_err}\n"
                          f"{(proc.stdout or '')[-1500:]}\n")
         if result is not None and result.get("retryable") is False:
-            break  # deterministic failure (bad mode, kernel mismatch)
+            # deterministic failure (bad mode, kernel mismatch): a code
+            # regression, not a tunnel flake — an old good record must
+            # NOT paper over it, so no stale fallback on this path
+            print(json.dumps({"metric": metric, "value": 0.0,
+                              "unit": "n/a", "vs_baseline": 0.0,
+                              "error": last_err}))
+            sys.exit(1)
+    stale = _stale_fallback(metric, last_err) if all_timeouts else None
+    if stale is not None:
+        sys.stderr.write("[bench] live attempts failed; emitting stale "
+                         f"record captured at "
+                         f"{stale.get('captured_at', '?')}\n")
+        print(json.dumps(stale))
+        return
     print(json.dumps({"metric": metric, "value": 0.0, "unit": "n/a",
                       "vs_baseline": 0.0, "error": last_err}))
     sys.exit(1)
@@ -375,7 +488,7 @@ def bench_decode() -> None:
                                           loop=beam_loop)  # compile
     np.asarray(jax.device_get(out.length))
     rtt = _tunnel_rtt()
-    lat = []
+    lat_raw = []
     tokens = 0
     t_total = 0.0
     for _ in range(iters):
@@ -383,24 +496,40 @@ def bench_decode() -> None:
         out = beam_search.run_beam_search_jit(params, hps, arrays,
                                               loop=beam_loop)
         # fetching the lengths (data-dependent on the whole decode loop)
-        # is the fence; subtract the measured tunnel round trip
+        # is the fence
         lengths = np.asarray(jax.device_get(out.length))
-        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
-        lat.append(dt / batch)
+        dt = time.perf_counter() - t0
+        lat_raw.append(dt / batch)
         t_total += dt
         # length includes START (beam_search.py:57-58); generated = len-1
         tokens += int(np.sum(lengths - 1))
-    lat.sort()
-    p50 = lat[len(lat) // 2]
-    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * q))]
+
+    # ADVICE r2: on a flaky tunnel the RTT variance can rival the decode
+    # latency itself, so a min-of-5 RTT subtraction can skew or collapse
+    # the corrected numbers.  Report BOTH: raw wall-clock percentiles
+    # (what a client of this backend actually observes) and
+    # RTT-corrected ones (the device-side estimate).  Raw is the
+    # headline value — it cannot be an artifact of the correction.
+    corr = [max(x - rtt / batch, 1e-9) for x in lat_raw]
     _, info = _device_info()
     rec = {
         "metric": "beam_decode_p50_latency_per_article",
-        "value": round(p50 * 1000, 2),
+        "value": round(pct(lat_raw, 0.5) * 1000, 2),
         "unit": "ms",
         "vs_baseline": 0.0,  # the reference publishes no decode latency
-        "p99_ms": round(p99 * 1000, 2),
+        "p99_ms": round(pct(lat_raw, 0.99) * 1000, 2),
+        "p50_rtt_corrected_ms": round(pct(corr, 0.5) * 1000, 2),
+        "p99_rtt_corrected_ms": round(pct(corr, 0.99) * 1000, 2),
         "tokens_per_sec": round(tokens / t_total, 1),
+        # null rather than a nonsense huge number when the RTT estimate
+        # swallows the whole window (flaky-tunnel RTT >= decode time)
+        "tokens_per_sec_rtt_corrected": (
+            round(tokens / (t_total - iters * rtt), 1)
+            if t_total > iters * rtt else None),
         "beam_size": hps.beam_size,
         "batch": batch,
         "beam_loop": beam_loop,
@@ -678,6 +807,10 @@ def bench_input() -> None:
 
 
 def child_main() -> None:
+    if os.environ.get("BENCH_SLEEP_FOR_TEST"):
+        # test hook: stand in for a hung TPU tunnel so the supervisor's
+        # timeout/stale-fallback path can be exercised without hardware
+        time.sleep(float(os.environ["BENCH_SLEEP_FOR_TEST"]))
     mode = os.environ.get("BENCH_MODE", "train")
     if mode == "decode":
         bench_decode()

@@ -15,7 +15,15 @@ set -uo pipefail
 OUT="${1:-BENCH_ALL.jsonl}"
 case "$OUT" in /*) ;; *) OUT="$PWD/$OUT" ;; esac  # resolve before the cd
 cd "$(dirname "$0")/.."
-: > "$OUT"  # truncate: reruns must not accumulate stale records
+# APPEND, never truncate: bench.py's stale fallback serves the NEWEST
+# matching record (file order == capture order), so older lines are
+# harmless — but truncating would destroy the very records the fallback
+# needs if the tunnel drops mid-sweep.  Each record carries captured_at
+# + config_fingerprint; summarize the latest per tag with
+# scripts/bench_latest.py.
+touch "$OUT"
+# the stale fallback must read the SAME file this sweep writes
+export BENCH_STALE_FILE="$OUT"
 
 run() {
   local tag="$1"; shift

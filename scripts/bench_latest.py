@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Summarize BENCH_ALL.jsonl: the newest record per run tag.
+
+The sweep file is append-only (scripts/bench_all.sh) so one sweep row
+can appear many times across reruns; BASELINE.md wants the latest view.
+
+    python scripts/bench_latest.py [BENCH_ALL.jsonl] [--json]
+
+Default output is a small aligned table; --json emits one JSON line per
+tag (newest record verbatim) for machine use.
+"""
+
+import json
+import sys
+
+
+def latest_by_tag(path):
+    latest = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            tag = rec.get("run") or rec.get("metric", "?")
+            latest[tag] = rec  # file order == capture order: last wins
+    return latest
+
+
+def main(argv):
+    args = [a for a in argv if not a.startswith("--")]
+    path = args[0] if args else "BENCH_ALL.jsonl"
+    latest = latest_by_tag(path)
+    if "--json" in argv:
+        for tag in latest:
+            print(json.dumps(latest[tag]))
+        return 0
+    width = max((len(t) for t in latest), default=3)
+    for tag, rec in latest.items():
+        if "error" in rec:
+            detail = f"ERROR: {rec['error'][:70]}"
+        else:
+            detail = f"{rec.get('value')} {rec.get('unit', '')}"
+            if rec.get("mfu") is not None:
+                detail += f"  mfu={rec['mfu']}"
+            if rec.get("captured_at"):
+                detail += f"  @{rec['captured_at']}"
+        print(f"{tag:<{width}}  {detail}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -59,6 +59,8 @@ Array = jax.Array
 
 NEG = -1e30  # effectively -inf, without inf-inf NaN hazards
 
+_loop_kind_logged: Dict[str, bool] = {}
+
 
 def _loop_kind(kind: Optional[str] = None) -> str:
     """Resolve the decode-loop construct: 'while' (early exit once every
@@ -75,13 +77,29 @@ def _loop_kind(kind: Optional[str] = None) -> str:
     its early exit is free and saves the tail steps.
 
     TS_BEAM_LOOP=while|scan|auto; auto (the default) picks scan when the
-    environment says the backend is the RPC-proxied axon plugin, else
-    while.
+    backend is the RPC-proxied axon plugin, else while.  The resolved
+    kind is logged once so a mis-detection is visible in decode logs
+    (ADVICE r2: JAX_PLATFORMS alone misses plugin auto-registration).
     """
     kind = (kind or os.environ.get("TS_BEAM_LOOP", "auto")).lower()
     if kind == "auto":
         proxied = "axon" in os.environ.get("JAX_PLATFORMS", "").lower()
-        return "scan" if proxied else "while"
+        if not proxied:
+            # the plugin may have been picked up via auto-registration or
+            # JAX_PLATFORM_NAME rather than JAX_PLATFORMS; ask jax which
+            # backend actually resolved (cheap after first init)
+            try:
+                proxied = "axon" in jax.default_backend().lower()
+            except Exception:  # backend init failure: fall through
+                pass
+        kind = "scan" if proxied else "while"
+        if not _loop_kind_logged.get(kind):
+            _loop_kind_logged[kind] = True
+            import logging
+            logging.getLogger(__name__).info(
+                "beam decode loop auto-resolved to %r (proxied=%s)",
+                kind, proxied)
+        return kind
     if kind not in ("while", "scan"):
         raise ValueError(
             f"beam loop kind must be while|scan|auto, got {kind!r} "
