@@ -210,3 +210,129 @@ def test_bridge_close_wakes_blocked_producer(record_queue):
     t.join(timeout=5)
     assert not t.is_alive()
     assert result == [False]
+
+
+# --------------------------------------------------------------------------
+# Kafka adapters against an in-memory fake broker (VERDICT r2 #7): the
+# reference's own Kafka test is 100% commented out
+# (KafkaSourceSinkTest.java:1-123); this proves the adapter logic —
+# Message JSON -> source rows -> sink -> Message JSON, max_count
+# bounding, per-record flush — without a broker process.
+# --------------------------------------------------------------------------
+
+class _FakeBroker:
+    """Topic -> list of raw message bytes; shared by fake producer+consumer."""
+
+    def __init__(self):
+        self.topics = {}
+        self.flushes = 0
+        self.consumer_kwargs = None
+
+    def make_module(self):
+        """A module-like namespace standing in for `kafka` in sys.modules."""
+        import types
+
+        broker = self
+
+        class KafkaConsumer:
+            def __init__(self, topic, bootstrap_servers=None, group_id=None,
+                         value_deserializer=None):
+                broker.consumer_kwargs = {
+                    "topic": topic, "bootstrap_servers": bootstrap_servers,
+                    "group_id": group_id}
+                deser = value_deserializer or (lambda b: b)
+
+                class _Msg:
+                    def __init__(self, value):
+                        self.value = value
+
+                self._msgs = [_Msg(deser(v))
+                              for v in broker.topics.get(topic, [])]
+
+            def __iter__(self):
+                return iter(self._msgs)
+
+        class KafkaProducer:
+            def __init__(self, bootstrap_servers=None):
+                self.closed = False
+
+            def send(self, topic, value):
+                broker.topics.setdefault(topic, []).append(value)
+
+            def flush(self):
+                broker.flushes += 1
+
+            def close(self):
+                self.closed = True
+
+        mod = types.ModuleType("kafka")
+        mod.KafkaConsumer = KafkaConsumer
+        mod.KafkaProducer = KafkaProducer
+        return mod
+
+
+@pytest.fixture()
+def fake_kafka(monkeypatch):
+    import sys
+
+    broker = _FakeBroker()
+    monkeypatch.setitem(sys.modules, "kafka", broker.make_module())
+    return broker
+
+
+def test_kafka_roundtrip_through_fake_broker(fake_kafka):
+    """Rows written by KafkaSink come back identically via KafkaSource —
+    the full Message-JSON wire round trip of App.java's topic plumbing
+    (flink_output producer -> flink_input consumer)."""
+    rows = [(f"uuid-{i}", f"article {i}.", "", f"reference {i}.")
+            for i in range(3)]
+    sink = io_lib.KafkaSink("flink_output", "fake:9092")
+    for row in rows:
+        sink.write(row)
+    sink.close()
+    # one flush per record: the Issue-6 fix (results must not wait for
+    # the NEXT record to arrive before becoming visible)
+    assert fake_kafka.flushes == 3
+    # the wire format is the reference's JSON Message, not pickled rows
+    wire = fake_kafka.topics["flink_output"]
+    assert all(isinstance(v, bytes) for v in wire)
+    assert json.loads(wire[0].decode("utf-8"))["uuid"] == "uuid-0"
+
+    src = io_lib.KafkaSource("flink_output", "fake:9092", group_id="g1")
+    assert list(src.rows()) == rows
+    assert fake_kafka.consumer_kwargs["bootstrap_servers"] == "fake:9092"
+    assert fake_kafka.consumer_kwargs["group_id"] == "g1"
+
+
+def test_kafka_source_max_count_bounds_stream(fake_kafka):
+    """max_count parity with MessageDeserializationSchema.java:34-40 (the
+    reference's bounded-stream hack): stop after N records even though
+    the topic has more."""
+    for i in range(5):
+        fake_kafka.topics.setdefault("flink_train", []).append(
+            io_lib.Message(uuid=f"u{i}", article=f"a{i}").to_json()
+            .encode("utf-8"))
+    src = io_lib.KafkaSource("flink_train", max_count=2)
+    got = list(src.rows())
+    assert [r[0] for r in got] == ["u0", "u1"]
+
+
+def test_kafka_missing_dependency_error(monkeypatch):
+    """Without kafka-python the adapters must fail with a clear,
+    actionable error at USE time (construction stays cheap)."""
+    import builtins
+    import sys
+
+    monkeypatch.delitem(sys.modules, "kafka", raising=False)
+    real_import = builtins.__import__
+
+    def no_kafka(name, *a, **kw):
+        if name == "kafka" or name.startswith("kafka."):
+            raise ImportError("No module named 'kafka'")
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(builtins, "__import__", no_kafka)
+    with pytest.raises(RuntimeError, match="kafka-python"):
+        list(io_lib.KafkaSource("t").rows())
+    with pytest.raises(RuntimeError, match="kafka-python"):
+        io_lib.KafkaSink("t").write(("u", "a", "", "r"))
