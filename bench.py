@@ -120,7 +120,12 @@ def _config_fingerprint() -> dict:
         fp["preset"] = os.environ.get("BENCH_PRESET", "ref") or "ref"
         fp["family"] = (os.environ.get("BENCH_FAMILY", "")
                         or "pointer_generator")
-        fp["pallas"] = os.environ.get("TS_PALLAS", "auto") or "auto"
+        # record the RESOLVED kernel choice, not the raw env string:
+        # "auto"'s meaning changed once (pallas-on-tpu -> xla), and a
+        # fingerprint of intent would cross-substitute semantically
+        # different measurements across that change
+        pallas_env = (os.environ.get("TS_PALLAS", "") or "auto").lower()
+        fp["pallas"] = "on" if pallas_env in ("1", "on", "true") else "off"
     if mode == "decode":
         # while vs scan decode loops differ by ~1.4 ms/iteration on the
         # tunneled backend — never cross-substitute their latencies

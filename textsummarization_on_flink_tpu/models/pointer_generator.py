@@ -255,18 +255,28 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
     _, (outputs, attn_dists, p_gens) = jax.lax.scan(
         step, init, jnp.swapaxes(emb_proj, 0, 1))
 
-    # hoisted projection + loss over all steps at once
-    scores = _proj(hps, outputs, w) + v  # [T_dec, B, V]
+    # hoisted projection + loss over all steps at once.  Memory note:
+    # the [T_dec, B, V] f32 scores tensor (~320 MB at reference scale)
+    # is also held as an autodiff residual (logsumexp/take_along_axis
+    # grads need it), so training peak HBM grows by roughly 2x its size;
+    # --remat recomputes it in backward instead (trade ~one extra
+    # projection matmul for the residual) for larger batches/vocabs.
     dec_mask = arrays["dec_padding_mask"]
     targets_t = jnp.swapaxes(arrays["target_batch"], 0, 1)  # [T_dec, B]
-    if hps.pointer_gen:
-        gold = loss_ops.gold_mixture_prob_from_scores(
-            scores, attn_dists, p_gens, targets_t,
-            arrays["enc_batch_extend_vocab"])
-        loss = loss_ops.pointer_nll(jnp.swapaxes(gold, 0, 1), dec_mask)
-    else:
-        loss = loss_ops.softmax_cross_entropy_baseline(
+
+    def scores_loss(outputs, attn_dists, p_gens):
+        scores = _proj(hps, outputs, w) + v  # [T_dec, B, V]
+        if hps.pointer_gen:
+            gold = loss_ops.gold_mixture_prob_from_scores(
+                scores, attn_dists, p_gens, targets_t,
+                arrays["enc_batch_extend_vocab"])
+            return loss_ops.pointer_nll(jnp.swapaxes(gold, 0, 1), dec_mask)
+        return loss_ops.softmax_cross_entropy_baseline(
             jnp.swapaxes(scores, 0, 1), arrays["target_batch"], dec_mask)
+
+    if hps.remat:
+        scores_loss = jax.checkpoint(scores_loss)
+    loss = scores_loss(outputs, attn_dists, p_gens)
     attn_b = jnp.swapaxes(attn_dists, 0, 1)  # [B, T_dec, T_enc]
     if hps.coverage:
         cov_loss = loss_ops.coverage_loss(attn_b, dec_mask)

@@ -64,9 +64,11 @@ def attend(attn_params: Dict[str, Array], enc_states: Array, enc_feats: Array,
     c, h = dec_state
     dec_in = jnp.concatenate([c, h], axis=-1)
     dec_feats = dec_in @ attn_params["linear_kernel"] + attn_params["linear_bias"]
-    # energy + masked softmax + context fused (Pallas on TPU, XLA elsewhere;
-    # energy-level masking is algebraically identical to the reference's
-    # softmax->mask->renorm pipeline)
+    # energy + masked softmax + context in one call (XLA formula by
+    # default — measured fastest; Pallas kernels opt-in via TS_PALLAS=on,
+    # see pallas_attention._use_pallas).  Energy-level masking is
+    # algebraically identical to the reference's softmax->mask->renorm
+    # pipeline.
     apply_cov = bool(use_coverage and coverage is not None)
     cov_in = coverage if apply_cov else jnp.zeros_like(enc_mask)
     context, attn_dist = pallas_attention.fused_attention(

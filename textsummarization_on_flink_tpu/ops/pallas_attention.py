@@ -269,15 +269,19 @@ _SIMPLE_KERNEL_MAX_ELEMS = 1_000_000
 
 
 def _use_pallas() -> bool:
+    """auto (default) prefers the XLA formula: on-device A/B at both
+    reference scale (B16 T400 D512) and long context (B4 T4096 D512)
+    measured the Pallas kernels at 0.99x / 0.94x of XLA on TPU v5e
+    (BASELINE.md round-2 attention_ab) — XLA's own fusion of this
+    additive-attention chain is already near-roofline, so the kernels
+    stay opt-in (TS_PALLAS=on) and serve the VMEM-constrained sp path
+    (blocked variant) rather than the default train step."""
     env = os.environ.get("TS_PALLAS", "auto").lower()
     if env in ("0", "off", "false"):
         return False
     if env in ("1", "on", "true"):
         return True
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:  # pragma: no cover
-        return False
+    return False
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(7,))

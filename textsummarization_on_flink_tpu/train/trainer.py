@@ -354,7 +354,10 @@ class Trainer:
                 self._dump_nan_batch(step, arrays)
                 raise NonFiniteLossError(
                     f"Loss is not finite. Stopping. "
-                    f"(step {step}, loss {loss})")
+                    f"(step {step}, loss {loss}; detection is windowed — "
+                    f"up to {self.metrics_every - 1} optimizer steps may "
+                    f"have run past the first bad one; --debug pins the "
+                    f"window to 1 for step-exact detection)")
             self.writer.scalars(step + 1, **scalars)
 
     def _dump_nan_batch(self, step: int, arrays) -> None:
