@@ -126,6 +126,12 @@ def _config_fingerprint() -> dict:
         # different measurements across that change
         pallas_env = (os.environ.get("TS_PALLAS", "") or "auto").lower()
         fp["pallas"] = "on" if pallas_env in ("1", "on", "true") else "off"
+        if os.environ.get("BENCH_UNROLL"):
+            fp["unroll"] = int(os.environ["BENCH_UNROLL"])
+        else:  # the HParams default (config.py is dependency-light)
+            from textsummarization_on_flink_tpu.config import HParams
+
+            fp["unroll"] = HParams.scan_unroll
     if mode == "decode":
         # while vs scan decode loops differ by ~1.4 ms/iteration on the
         # tunneled backend — never cross-substitute their latencies
@@ -384,6 +390,8 @@ def _preset_overrides() -> dict:
                    min_dec_steps=1, max_oov_buckets=8)
     elif os.environ.get("BENCH_PRESET") == "scaled":
         out.update(hidden_dim=512, max_enc_steps=800)
+    if os.environ.get("BENCH_UNROLL"):
+        out["scan_unroll"] = int(os.environ["BENCH_UNROLL"])
     family = os.environ.get("BENCH_FAMILY", "")
     if family:
         out["model_family"] = family

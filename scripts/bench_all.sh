@@ -42,6 +42,7 @@ print(json.dumps(rec))" >> "$OUT"
 
 run train_b16            BENCH_MODE=train
 run train_b16_pallas     BENCH_MODE=train TS_PALLAS=on
+run train_b16_unroll1    BENCH_MODE=train BENCH_UNROLL=1
 run train_b64            BENCH_MODE=train BENCH_BATCH=64
 run train_scaled         BENCH_MODE=train BENCH_PRESET=scaled
 run train_transformer    BENCH_MODE=train BENCH_FAMILY=transformer

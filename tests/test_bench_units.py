@@ -107,7 +107,7 @@ def test_config_fingerprint_distinguishes_sweep_rows(monkeypatch):
     base = bench._config_fingerprint()
     assert base == {"mode": "train", "platform": "tpu", "batch": 16,
                     "preset": "ref", "family": "pointer_generator",
-                    "pallas": "off"}
+                    "pallas": "off", "unroll": 8}
     monkeypatch.setenv("BENCH_BATCH", "64")
     assert bench._config_fingerprint() != base
     # a CPU smoke record must never satisfy a TPU ask
@@ -234,7 +234,7 @@ def test_supervisor_emits_stale_record_when_tunnel_down(tmp_path):
     import subprocess
 
     fp = {"mode": "train", "platform": "cpu", "batch": 16, "preset": "ref",
-          "family": "pointer_generator", "pallas": "off"}
+          "family": "pointer_generator", "pallas": "off", "unroll": 8}
     path = tmp_path / "BENCH_ALL.jsonl"
     _write_jsonl(path, [
         {"metric": "train_samples_per_sec", "value": 552.8,

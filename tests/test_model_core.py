@@ -328,3 +328,28 @@ def test_pg_remat_gradient_parity():
         a, b = np.asarray(a), np.asarray(b)
         scale = np.max(np.abs(a)) + 1e-12
         assert np.max(np.abs(a - b)) / scale < 1e-5
+
+
+def test_scan_unroll_numeric_identity():
+    """hps.scan_unroll only changes how XLA schedules the recurrence
+    (loop-overhead amortization, PERF.md); forward loss and gradients
+    must be identical to the unroll=1 schedule up to FP reassociation."""
+    hps = hps_tiny(scan_unroll=1)
+    vocab = make_vocab()
+    batch = make_batch(hps, vocab)
+    params = pg.init_params(hps, vocab.size(), jax.random.PRNGKey(5))
+    arrays = batch.as_arrays()
+
+    def loss(p, h):
+        return pg.forward_train(p, h, arrays).total_loss
+
+    l1 = float(loss(params, hps))
+    l8 = float(loss(params, hps.replace(scan_unroll=8)))
+    assert l1 == pytest.approx(l8, rel=1e-6)
+    g1 = jax.grad(loss)(params, hps)
+    g8 = jax.grad(loss)(params, hps.replace(scan_unroll=8))
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g8)):
+        a, b = np.asarray(a), np.asarray(b)
+        scale = np.max(np.abs(a)) + 1e-12
+        assert np.max(np.abs(a - b)) / scale < 1e-5

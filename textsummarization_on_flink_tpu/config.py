@@ -92,6 +92,14 @@ class HParams:
     # trades ~1/3 more FLOPs for O(layers) less activation HBM — for the
     # long-context configs (enc 800+) where activations dominate
     remat: bool = False
+    # lax.scan unroll factor for the LSTM encoder / decoder recurrences
+    # (pointer-generator family).  The step is LATENCY-bound: ~500
+    # sequential scan iterations of small matmuls dominate the 29 ms
+    # measured step (BASELINE.md), so amortizing per-iteration loop
+    # overhead across k unrolled bodies is the lever XLA can't pull
+    # itself.  Numerically identical at any value; raises compile time
+    # with k.  1 = no unrolling.
+    scan_unroll: int = 8
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score

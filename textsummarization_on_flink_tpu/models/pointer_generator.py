@@ -172,7 +172,7 @@ def encode(params: Params, hps: HParams, enc_batch: Array, enc_lens: Array,
     emb = _cast(hps, emb)
     enc_states, fw_st, bw_st = lstm_ops.bidirectional_encoder(
         params["encoder"]["fw"], params["encoder"]["bw"], emb, enc_lens,
-        enc_padding_mask)
+        enc_padding_mask, unroll=hps.scan_unroll)
     enc_states = enc_states.astype(jnp.float32)
     # _reduce_states (model.py:97-121): ReLU linear from fw||bw to H
     r = params["reduce"]
@@ -253,7 +253,8 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
     init = (enc.dec_in_state, jnp.zeros((B, D), jnp.float32),
             jnp.zeros((B, T_enc), jnp.float32))
     _, (outputs, attn_dists, p_gens) = jax.lax.scan(
-        step, init, jnp.swapaxes(emb_proj, 0, 1))
+        step, init, jnp.swapaxes(emb_proj, 0, 1),
+        unroll=max(hps.scan_unroll, 1))
 
     # hoisted projection + loss over all steps at once.  Memory note:
     # the [T_dec, B, V] f32 scores tensor (~320 MB at reference scale)
