@@ -300,6 +300,16 @@ def test_bf16_forward_close_to_f32():
     out32 = pg.forward_train(params, hps, arrays)
     out16 = pg.forward_train(params, hps.replace(compute_dtype="bfloat16"),
                              arrays)
+    # the encoder stream (re-read every decoder step) must actually be
+    # bf16 — that is the HBM-bandwidth point of bf16 mode
+    enc16 = pg.encode(params, hps.replace(compute_dtype="bfloat16"),
+                      arrays["enc_batch"], arrays["enc_lens"],
+                      arrays["enc_padding_mask"])
+    assert enc16.enc_states.dtype == jnp.bfloat16
+    assert enc16.enc_features.dtype == jnp.bfloat16
+    enc32 = pg.encode(params, hps, arrays["enc_batch"], arrays["enc_lens"],
+                      arrays["enc_padding_mask"])
+    assert enc32.enc_states.dtype == jnp.float32
     assert np.isfinite(float(out16.loss))
     np.testing.assert_allclose(float(out16.loss), float(out32.loss),
                                rtol=3e-2)

@@ -174,3 +174,24 @@ def test_fully_masked_row_is_finite_masked_softmax():
     out = np.asarray(attn_ops.masked_softmax(e, mask))
     assert np.isfinite(out).all()
     np.testing.assert_array_equal(out[0], 0.0)
+
+
+@pytest.mark.parametrize("blocked", [False, True])
+def test_kernels_accept_bf16_encoder_stream(blocked):
+    """compute_dtype=bfloat16 hands the kernels bf16 es/ef; the upcast
+    must happen IN VMEM (f32 math inside), matching the XLA formula fed
+    the same bf16 inputs."""
+    args = list(make_inputs(B=2, T=40, D=16, seed=7))
+    args[0] = jnp.asarray(args[0], jnp.bfloat16)  # enc_states
+    args[1] = jnp.asarray(args[1], jnp.bfloat16)  # enc_feats
+    ctx_ref, attn_ref = pa._attention_xla(*args, True)
+    if blocked:
+        ctx_k, attn_k = pa._attention_pallas_blocked(*args, True, block_t=16,
+                                                     interpret=True)
+    else:
+        ctx_k, attn_k = pa._attention_pallas(*args, True, interpret=True)
+    assert ctx_k.dtype == jnp.float32 and attn_k.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(ctx_k), np.asarray(ctx_ref),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(attn_k), np.asarray(attn_ref),
+                               rtol=1e-2, atol=1e-3)

@@ -173,7 +173,14 @@ def encode(params: Params, hps: HParams, enc_batch: Array, enc_lens: Array,
     enc_states, fw_st, bw_st = lstm_ops.bidirectional_encoder(
         params["encoder"]["fw"], params["encoder"]["bw"], emb, enc_lens,
         enc_padding_mask, unroll=hps.scan_unroll)
-    enc_states = enc_states.astype(jnp.float32)
+    # The decoder attention re-streams enc_states AND enc_feats from HBM
+    # on EVERY decode step (T_dec x 2 x [B, T, D] — the step's dominant
+    # bandwidth consumer), so in bf16 mode keep both in bf16: half the
+    # bytes.  The attention energies/softmax still run in f32 — the op's
+    # f32 dec_feats promote the arithmetic, so only the HBM
+    # representation narrows, not the softmax math.
+    if hps.compute_dtype != "bfloat16":
+        enc_states = enc_states.astype(jnp.float32)
     # _reduce_states (model.py:97-121): ReLU linear from fw||bw to H
     r = params["reduce"]
     old_c = jnp.concatenate([fw_st[0], bw_st[0]], axis=-1)

@@ -42,8 +42,13 @@ def masked_softmax(e: Array, enc_mask: Array) -> Array:
 
 
 def encoder_features(attn_params: Dict[str, Array], enc_states: Array) -> Array:
-    """W_h h_i for every encoder position. enc_states: [B, T, D] -> [B, T, D]."""
-    return enc_states @ attn_params["W_h"]
+    """W_h h_i for every encoder position. enc_states: [B, T, D] -> [B, T, D].
+
+    Computed in the encoder-stream dtype (bf16 under compute_dtype=
+    bfloat16): the result is re-read from HBM every decoder step, so its
+    width — not this matmul's precision — is what matters; the attention
+    op promotes to f32 before the softmax either way."""
+    return enc_states @ attn_params["W_h"].astype(enc_states.dtype)
 
 
 def attend(attn_params: Dict[str, Array], enc_states: Array, enc_feats: Array,

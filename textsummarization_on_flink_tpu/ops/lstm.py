@@ -107,7 +107,10 @@ def bidirectional_encoder(fw_params: Dict[str, Array], bw_params: Dict[str, Arra
         new_c, new_h = _apply_gates(z, c, forget_bias)
         c = jnp.where(m > 0, new_c, c)
         h = jnp.where(m > 0, new_h, h)
-        return (c, h), new_h * m
+        # multiply in the activation dtype: an f32 mask would silently
+        # promote the whole output stream (and everything downstream
+        # that re-reads it) back to f32
+        return (c, h), new_h * m.astype(new_h.dtype)
 
     zero2 = (jnp.zeros((2, B, H), inputs.dtype),
              jnp.zeros((2, B, H), inputs.dtype))

@@ -65,7 +65,11 @@ def _kernel(es_ref, ef_ref, mask_ref, df_ref, cov_ref, v_ref, wc_ref,
     dim, so per-row [T, 1] columns and [1, D] rows are legal while plain
     [1, T] per-row slices of a [B, T] array are not.
     """
-    ef = ef_ref[0]                       # [T, D]
+    # es/ef arrive in their HBM dtype (bf16 under compute_dtype=bfloat16
+    # — casting to f32 OUTSIDE the kernel would materialize full-width
+    # copies in HBM and forfeit the bf16 bandwidth win); upcast here, in
+    # VMEM, so the energy/softmax math is f32 regardless
+    ef = ef_ref[0].astype(jnp.float32)   # [T, D]
     feats = ef + df_ref[0]               # + [1, D]
     if use_coverage:
         feats = feats + cov_ref[0] * wc_ref[...]   # [T, 1] * [1, D]
@@ -84,7 +88,7 @@ def _kernel(es_ref, ef_ref, mask_ref, df_ref, cov_ref, v_ref, wc_ref,
     # HIGHEST precision keeps full f32 (the matvec is a sliver of the
     # kernel's work; default bf16 passes cost ~1e-2 absolute ctx error)
     ctx_ref[0] = jax.lax.dot_general(
-        a, es_ref[0], (((0,), (0,)), ((), ())),
+        a, es_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32)
 
@@ -144,7 +148,9 @@ def _attention_pallas(enc_states, enc_feats, enc_mask, dec_feats, coverage,
             jax.ShapeDtypeStruct((B, Tp, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(es.astype(jnp.float32), ef.astype(jnp.float32),
+        # es/ef keep their HBM dtype (bf16 mode streams half the bytes);
+        # the kernel upcasts in VMEM
+    )(es, ef,
       mask.astype(jnp.float32)[:, :, None], df.astype(jnp.float32)[:, None, :],
       cov.astype(jnp.float32)[:, :, None], vp[None].astype(jnp.float32),
       wcp[None].astype(jnp.float32))
@@ -174,7 +180,9 @@ def _blocked_kernel(es_ref, ef_ref, mask_ref, df_ref, cov_ref, v_ref, wc_ref,
         l_scr[0, 0] = 0.0
         ctx_scr[:, :] = jnp.zeros_like(ctx_scr)
 
-    ef = ef_ref[0]                       # [Tb, D]
+    # upcast in VMEM (see _kernel): es/ef stream HBM->VMEM at their
+    # native width, possibly bf16
+    ef = ef_ref[0].astype(jnp.float32)   # [Tb, D]
     feats = ef + df_ref[0]               # + [1, D]
     if use_coverage:
         feats = feats + cov_ref[0] * wc_ref[...]   # [Tb, 1] * [1, D]
@@ -190,7 +198,7 @@ def _blocked_kernel(es_ref, ef_ref, mask_ref, df_ref, cov_ref, v_ref, wc_ref,
     p = jnp.where(mask > 0, jnp.exp(e - m_new), 0.0)   # [Tb, 1]
     l_scr[0, 0] = l_scr[0, 0] * scale + jnp.sum(p)
     ctx_scr[:, :] = ctx_scr[:, :] * scale + jax.lax.dot_general(
-        p, es_ref[0], (((0,), (0,)), ((), ())),
+        p, es_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32)
     m_scr[0, 0] = m_new
@@ -249,7 +257,8 @@ def _attention_pallas_blocked(enc_states, enc_feats, enc_mask, dec_feats,
             pltpu.VMEM((1, Dp), jnp.float32),
         ],
         interpret=interpret,
-    )(es.astype(jnp.float32), ef.astype(jnp.float32),
+        # es/ef in their HBM dtype; in-kernel upcast (see _kernel)
+    )(es, ef,
       mask.astype(jnp.float32)[:, :, None], df.astype(jnp.float32)[:, None, :],
       cov.astype(jnp.float32)[:, :, None], vp.astype(jnp.float32),
       wcp.astype(jnp.float32))
