@@ -483,14 +483,20 @@ def bench_decode() -> None:
 
     from textsummarization_on_flink_tpu.config import HParams
     from textsummarization_on_flink_tpu.decode import beam_search
-    from textsummarization_on_flink_tpu.models import pointer_generator as pg
+    from textsummarization_on_flink_tpu.models import get_family
     from __graft_entry__ import _example_arrays
 
     iters = int(os.environ.get("BENCH_STEPS", "10"))
     batch = int(os.environ.get("BENCH_BATCH", "4"))
     hps = HParams(batch_size=batch, mode="decode", coverage=True,
                   **_preset_overrides())
-    params = pg.init_params(hps, hps.vocab_size, jax.random.PRNGKey(0))
+    # coverage mirrors the reference decode config for the pg family
+    # (TensorFlowTest.java:40-53); the transformer decode path never
+    # reads it
+    if hps.model_family == "transformer":
+        hps = hps.replace(coverage=False)
+    family = get_family(hps.model_family)
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(0))
     arrays = _example_arrays(hps, np.random.RandomState(0))
     arrays = {k: v for k, v in arrays.items()
               if not k.startswith(("dec_", "target_"))}

@@ -6,10 +6,10 @@
 #
 #     scripts/bench_all.sh [out.jsonl]
 #
-# Runs: train at reference batch 16 (Pallas on AND off — picks the
-# attention default), train at batch 64, train scaled (hidden 512 /
-# enc 800), transformer-family train, decode latency, attention +
-# flash kernel A/Bs, host input pipeline.
+# Runs: train at reference batch 16 (with Pallas-kernel and unroll=1
+# A/B rows), train at batch 64, train scaled (hidden 512 / enc 800),
+# transformer-family train, decode latency for BOTH families,
+# attention + flash kernel A/Bs, host input pipeline.
 set -uo pipefail
 
 OUT="${1:-BENCH_ALL.jsonl}"
@@ -47,6 +47,7 @@ run train_b64            BENCH_MODE=train BENCH_BATCH=64
 run train_scaled         BENCH_MODE=train BENCH_PRESET=scaled
 run train_transformer    BENCH_MODE=train BENCH_FAMILY=transformer
 run decode_b4            BENCH_MODE=decode
+run decode_transformer   BENCH_MODE=decode BENCH_FAMILY=transformer
 run attention_ab         BENCH_MODE=attention
 run flash_ab             BENCH_MODE=flash
 run input_pipeline       BENCH_MODE=input
