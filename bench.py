@@ -133,9 +133,14 @@ def _config_fingerprint() -> dict:
 
             fp["unroll"] = HParams.scan_unroll
     if mode == "decode":
-        # while vs scan decode loops differ by ~1.4 ms/iteration on the
-        # tunneled backend — never cross-substitute their latencies
-        fp["beam_loop"] = os.environ.get("TS_BEAM_LOOP", "auto") or "auto"
+        # while vs scan vs chunked decode loops differ by ~1.4 ms per
+        # dynamic iteration on the tunneled backend — never
+        # cross-substitute their latencies (nor chunk sizes: C=1 is
+        # per-step dynamic cost, C=T degenerates to scan)
+        loop = (os.environ.get("TS_BEAM_LOOP", "auto") or "auto").lower()
+        fp["beam_loop"] = loop
+        if loop == "chunked":
+            fp["chunk"] = int(os.environ.get("TS_BEAM_CHUNK", "25"))
     elif mode == "flash":
         fp["flash_t"] = int(os.environ.get("BENCH_FLASH_T", "2048"))
     elif mode == "input":
@@ -503,8 +508,10 @@ def bench_decode() -> None:
     arrays = jax.device_put(arrays)
 
     beam_loop = beam_search._loop_kind()  # TS_BEAM_LOOP env override
+    chunk = beam_search.resolved_chunk(beam_loop)  # part of the cache key
     out = beam_search.run_beam_search_jit(params, hps, arrays,
-                                          loop=beam_loop)  # compile
+                                          loop=beam_loop,
+                                          chunk=chunk)  # compile
     np.asarray(jax.device_get(out.length))
     rtt = _tunnel_rtt()
     lat_raw = []
@@ -513,7 +520,7 @@ def bench_decode() -> None:
     for _ in range(iters):
         t0 = time.perf_counter()
         out = beam_search.run_beam_search_jit(params, hps, arrays,
-                                              loop=beam_loop)
+                                              loop=beam_loop, chunk=chunk)
         # fetching the lengths (data-dependent on the whole decode loop)
         # is the fence
         lengths = np.asarray(jax.device_get(out.length))

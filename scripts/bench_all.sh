@@ -47,6 +47,7 @@ run train_b64            BENCH_MODE=train BENCH_BATCH=64
 run train_scaled         BENCH_MODE=train BENCH_PRESET=scaled
 run train_transformer    BENCH_MODE=train BENCH_FAMILY=transformer
 run decode_b4            BENCH_MODE=decode
+run decode_chunked       BENCH_MODE=decode TS_BEAM_LOOP=chunked
 run decode_transformer   BENCH_MODE=decode BENCH_FAMILY=transformer
 run attention_ab         BENCH_MODE=attention
 run flash_ab             BENCH_MODE=flash

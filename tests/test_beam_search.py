@@ -163,15 +163,21 @@ def test_output_invariants(params):
             assert row[L:].sum() < 1e-6
 
 
+@pytest.mark.parametrize("kind", ["scan", "chunked"])
 @pytest.mark.parametrize("coverage", [False, True])
-def test_scan_loop_matches_while_loop(params, coverage):
-    """TS_BEAM_LOOP=scan (fixed trip count, masked updates — auto-picked
-    on RPC-proxied backends to dodge per-while-iteration host round
-    trips) must be token-exact with the early-exit while_loop."""
+def test_loop_kinds_match_while_loop(params, coverage, kind):
+    """TS_BEAM_LOOP=scan (fixed trip count, masked updates) and =chunked
+    (while over scan chunks — early exit at chunk granularity, ceil(T/C)
+    dynamic iterations on RPC-proxied backends) must be token-exact with
+    the early-exit while_loop."""
+    # chunk=3 does NOT divide max_dec_steps: the masked inner scan must
+    # make the overshoot a no-op (chunk is a static jit cache-key arg)
+    chunk = 3 if kind == "chunked" else None
     hps = HPS.replace(coverage=coverage)
     arrays = make_arrays(hps, seed=5)
     a = beam_search.run_beam_search_jit(params, hps, arrays, loop="while")
-    b = beam_search.run_beam_search_jit(params, hps, arrays, loop="scan")
+    b = beam_search.run_beam_search_jit(params, hps, arrays, loop=kind,
+                                        chunk=chunk)
     np.testing.assert_array_equal(np.asarray(a.tokens), np.asarray(b.tokens))
     np.testing.assert_array_equal(np.asarray(a.length), np.asarray(b.length))
     np.testing.assert_allclose(np.asarray(a.avg_log_prob),

@@ -64,11 +64,14 @@ _loop_kind_logged: Dict[str, bool] = {}
 
 def _loop_kind(kind: Optional[str] = None) -> str:
     """Resolve the decode-loop construct: 'while' (early exit once every
-    article's beam finishes) or 'scan' (fixed max_dec_steps trip count).
+    article's beam finishes), 'scan' (fixed max_dec_steps trip count),
+    or 'chunked' (while over TS_BEAM_CHUNK-step scan chunks — early exit
+    at chunk granularity with only ceil(T/C) dynamic iterations).
 
-    The two produce IDENTICAL results: under vmap a while_loop already
+    All three produce IDENTICAL results: under vmap a while_loop already
     applies masked per-article updates until the slowest article's cond
-    goes false; scan merely fixes the trip count at the worst case.  What
+    goes false; scan merely fixes the trip count at the worst case, and
+    chunked interleaves the two at chunk granularity.  What
     scan buys is freedom from per-iteration host involvement — on an
     RPC-proxied backend (the tunneled axon TPU) every dynamic-condition
     loop iteration costs ~1.4 ms of round trip, ~140 ms per batch at the
@@ -76,10 +79,12 @@ def _loop_kind(kind: Optional[str] = None) -> str:
     directly attached backend while's condition evaluates on device, so
     its early exit is free and saves the tail steps.
 
-    TS_BEAM_LOOP=while|scan|auto; auto (the default) picks scan when the
-    backend is the RPC-proxied axon plugin, else while.  The resolved
-    kind is logged once so a mis-detection is visible in decode logs
-    (ADVICE r2: JAX_PLATFORMS alone misses plugin auto-registration).
+    TS_BEAM_LOOP=while|scan|chunked|auto; auto (the default) picks scan
+    when the backend is the RPC-proxied axon plugin, else while
+    (chunked is opt-in until the decode sweep row proves it).  The
+    resolved kind is logged once so a mis-detection is visible in decode
+    logs (ADVICE r2: JAX_PLATFORMS alone misses plugin
+    auto-registration).
     """
     kind = (kind or os.environ.get("TS_BEAM_LOOP", "auto")).lower()
     if kind == "auto":
@@ -100,9 +105,9 @@ def _loop_kind(kind: Optional[str] = None) -> str:
                 "beam decode loop auto-resolved to %r (proxied=%s)",
                 kind, proxied)
         return kind
-    if kind not in ("while", "scan"):
+    if kind not in ("while", "scan", "chunked"):
         raise ValueError(
-            f"beam loop kind must be while|scan|auto, got {kind!r} "
+            f"beam loop kind must be while|scan|chunked|auto, got {kind!r} "
             f"(TS_BEAM_LOOP or the loop= argument)")
     return kind
 
@@ -132,14 +137,16 @@ class _BeamState(NamedTuple):
     res_pgen: Array  # [K+1, T]
 
 
-def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, enc_one,
-                enc_mask, ext_ids) -> BeamSearchOutput:
+def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, chunk,
+                enc_one, enc_mask, ext_ids) -> BeamSearchOutput:
     """Beam search for ONE article (un-batched inputs; vmapped below).
 
     enc_one: the family's per-article encoder view (pytree, no batch
     axis); enc_mask: [T_enc]; ext_ids: [T_enc] extended-vocab ids.
     init_state_fn/step_fn: the family's beam adapter (models/__init__).
-    loop: 'while' or 'scan' (see _loop_kind).
+    loop: 'while', 'scan', or 'chunked' (see _loop_kind); chunk: the
+    chunked inner-scan length, or None for the TS_BEAM_CHUNK env default
+    (read here, at trace time).
     """
     K = hps.beam_size
     T = hps.max_dec_steps
@@ -230,21 +237,38 @@ def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, enc_one,
             res_pgen=res_pgen,
         )
 
+    # scan with masked updates: once cond(s) goes false the state is
+    # carried through unchanged, so the result is token-exact with
+    # the while_loop (whose vmapped form does the same masking).
+    # body's garbage reads at t == T (OOB gathers clamp, OOB scatter
+    # writes drop) are discarded by the select.
+    def scan_body(s, _):
+        s2 = body(s)
+        keep = cond(s)
+        s = jax.tree_util.tree_map(
+            lambda old, new: jnp.where(keep, new, old), s, s2)
+        return s, None
+
     if loop == "while":
         s = jax.lax.while_loop(cond, body, init)
-    else:
-        # scan with masked updates: once cond(s) goes false the state is
-        # carried through unchanged, so the result is token-exact with
-        # the while_loop (whose vmapped form does the same masking).
-        # body's garbage reads at t == T (OOB gathers clamp, OOB scatter
-        # writes drop) are discarded by the select.
-        def scan_body(s, _):
-            s2 = body(s)
-            keep = cond(s)
-            s = jax.tree_util.tree_map(
-                lambda old, new: jnp.where(keep, new, old), s, s2)
-            return s, None
+    elif loop == "chunked":
+        # while over fixed-size scan chunks: the RPC-proxied backend
+        # charges ~1.4 ms per DYNAMIC loop iteration (host round trip on
+        # the condition) but nothing per scan step, so ceil(T/C) dynamic
+        # iterations buy while-style early exit (typical beams finish
+        # well before max_dec_steps) at near-scan dispatch cost.  The
+        # masked inner scan makes overshooting a chunk a no-op, so the
+        # result stays token-exact with both other kinds.
+        if chunk is None:  # env fallback, read at trace time
+            chunk = int(os.environ.get("TS_BEAM_CHUNK", "25"))
+        C = min(max(int(chunk), 1), T)
 
+        def chunk_body(s):
+            s, _ = jax.lax.scan(scan_body, s, None, length=C)
+            return s
+
+        s = jax.lax.while_loop(cond, chunk_body, init)
+    else:
         s, _ = jax.lax.scan(scan_body, init, None, length=T)
 
     # results empty -> fall back to the live beam (beam_search.py:158-160)
@@ -275,26 +299,40 @@ def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, enc_one,
 
 
 def _search_batch(params, hps: HParams, arrays: Dict[str, Array],
-                  loop: Optional[str] = None) -> BeamSearchOutput:
+                  loop: Optional[str] = None,
+                  chunk: Optional[int] = None) -> BeamSearchOutput:
     """Encode a batch of B articles once, then vmap the per-article search.
 
-    loop=None reads TS_BEAM_LOOP at trace time (fine for callers that
-    trace once, like the sharded step in parallel/mesh.py; jit callers
-    that must react to env changes pass it explicitly).
+    loop=None / chunk=None read TS_BEAM_LOOP / TS_BEAM_CHUNK at trace
+    time (fine for callers that trace once, like the sharded step in
+    parallel/mesh.py; jit callers that must react to env changes pass
+    them explicitly — they are static cache-key arguments on
+    run_beam_search_jit).
     """
     family = get_family(hps.model_family)
     enc_view = family.beam_encode(params, hps, arrays)
     init_state_fn, step_fn = family.beam_adapter(hps)
     fn = functools.partial(_search_one, params, hps, init_state_fn, step_fn,
-                           _loop_kind(loop))
+                           _loop_kind(loop), chunk)
     return jax.vmap(fn)(enc_view, arrays["enc_padding_mask"],
                         arrays["enc_batch_extend_vocab"])
 
 
-@functools.partial(jax.jit, static_argnames=("hps", "loop"))
+@functools.partial(jax.jit, static_argnames=("hps", "loop", "chunk"))
 def run_beam_search_jit(params, hps: HParams, arrays: Dict[str, Array],
-                        loop: Optional[str] = None) -> BeamSearchOutput:
-    return _search_batch(params, hps, arrays, loop)
+                        loop: Optional[str] = None,
+                        chunk: Optional[int] = None) -> BeamSearchOutput:
+    return _search_batch(params, hps, arrays, loop, chunk)
+
+
+def resolved_chunk(loop: str) -> Optional[int]:
+    """The effective chunked inner-scan length, resolved from the env —
+    pass this to run_beam_search_jit so the chunk size participates in
+    the jit cache key (an env change between calls would otherwise be
+    silently ignored by the cached executable)."""
+    if loop != "chunked":
+        return None
+    return int(os.environ.get("TS_BEAM_CHUNK", "25"))
 
 
 def run_beam_search(params, hps: HParams, arrays: Dict[str, np.ndarray],
@@ -304,5 +342,7 @@ def run_beam_search(params, hps: HParams, arrays: Dict[str, np.ndarray],
     Returns host numpy BeamSearchOutput; callers strip START/[STOP] and map
     ids back to words (decode/decoder.py, mirroring decode.py:109-119).
     """
-    out = run_beam_search_jit(params, hps, arrays, loop=_loop_kind())
+    loop = _loop_kind()
+    out = run_beam_search_jit(params, hps, arrays, loop=loop,
+                              chunk=resolved_chunk(loop))
     return BeamSearchOutput(*[np.asarray(x) for x in out])
