@@ -140,6 +140,9 @@ def _config_fingerprint() -> dict:
         loop = (os.environ.get("TS_BEAM_LOOP", "auto") or "auto").lower()
         fp["beam_loop"] = loop
         if loop == "chunked":
+            # default mirrors beam_search.resolved_chunk (this supervisor
+            # must not import jax-importing modules: with the axon plugin
+            # on PYTHONPATH and the tunnel down, jax import hangs)
             fp["chunk"] = int(os.environ.get("TS_BEAM_CHUNK", "25"))
     elif mode == "flash":
         fp["flash_t"] = int(os.environ.get("BENCH_FLASH_T", "2048"))

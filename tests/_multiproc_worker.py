@@ -102,8 +102,12 @@ def main() -> int:
         os.path.basename(p) for p in os.listdir(train_dir)
         if p.endswith(".npz"))
 
-    # resume-from-checkpoint must keep collectives in lockstep too
-    trainer2 = Trainer(hps, vocab.size(), FixedBatcher(local_batch, 50),
+    # resume-from-checkpoint must keep collectives in lockstep too; the
+    # resumed run also exercises multi-step dispatch (steps_per_dispatch
+    # scans k sharded steps — with their dp-axis psums — in ONE dispatch
+    # per host)
+    trainer2 = Trainer(hps.replace(steps_per_dispatch=2), vocab.size(),
+                       FixedBatcher(local_batch, 50),
                        state=restored, checkpointer=ckpt,
                        checkpoint_steps=3, train_dir=train_dir)
     state2 = trainer2.train(num_steps=7)  # 2 more steps past the restore

@@ -288,3 +288,70 @@ def test_trainer_auto_shards_on_mesh(tmp_path):
     # params actually live on the 8-device mesh
     leaf = jax.tree_util.tree_leaves(state.params)[0]
     assert len(leaf.sharding.device_set) == 8
+
+
+class TestStepsPerDispatch:
+    """steps_per_dispatch=k runs k optimizer steps as one on-device scan
+    (the TPU steps_per_execution pattern) — must be step-for-step
+    identical to k=1 in losses, summaries, and final params."""
+
+    def _run(self, tmp_path, k, n_batches=10, num_steps=10, **hkw):
+        import json as json_lib
+
+        hps = hps_tiny(log_root=str(tmp_path), exp_name=f"k{k}",
+                       steps_per_dispatch=k, **hkw)
+        vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+        batch = make_batch(hps, vocab)
+        trainer = Trainer(hps, vocab.size(), FixedBatcher(batch, n_batches),
+                          metrics_every=3)
+        state = trainer.train(num_steps=num_steps)
+        trainer.writer.close()
+        path = tmp_path / f"k{k}" / "train" / "events.jsonl"
+        recs = [json_lib.loads(l) for l in open(path)]
+        return state, recs
+
+    def test_k4_matches_k1(self, tmp_path):
+        s1, r1 = self._run(tmp_path, 1)
+        s4, r4 = self._run(tmp_path, 4)
+        assert [r["step"] for r in r1] == [r["step"] for r in r4]
+        losses1 = [r["loss"] for r in r1]
+        losses4 = [r["loss"] for r in r4]
+        np.testing.assert_allclose(losses4, losses1, rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                        jax.tree_util.tree_leaves(s4.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+        assert int(np.asarray(s4.step)) == 10
+
+    def test_limit_exact_when_k_does_not_divide(self, tmp_path):
+        # 10 steps at k=4 -> dispatches of 4, 4, 2
+        state, recs = self._run(tmp_path, 4, n_batches=50, num_steps=10)
+        assert int(np.asarray(state.step)) == 10
+        assert [r["step"] for r in recs] == list(range(1, 11))
+
+    def test_exhaustion_tail_single_host(self, tmp_path):
+        # 7 batches, no limit: k=4 dispatches 4 then the 3-batch tail
+        state, recs = self._run(tmp_path, 4, n_batches=7, num_steps=0)
+        assert int(np.asarray(state.step)) == 7
+        assert [r["step"] for r in recs] == list(range(1, 8))
+
+    def test_debug_forces_k1(self, tmp_path):
+        hps = hps_tiny(steps_per_dispatch=8, debug=True)
+        vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+        batch = make_batch(hps, vocab)
+        trainer = Trainer(hps, vocab.size(), FixedBatcher(batch, 2))
+        assert trainer.steps_per_dispatch == 1
+
+    def test_watchdog_fires_inside_multi_dispatch(self, tmp_path):
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="nan",
+                       steps_per_dispatch=4)
+        vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+        batch = make_batch(hps, vocab)
+        trainer = Trainer(hps, vocab.size(), FixedBatcher(batch, 20),
+                          metrics_every=4)
+        bad = jax.tree_util.tree_map(
+            lambda x: np.full_like(np.asarray(x), np.nan),
+            jax.device_get(trainer.state.params))
+        trainer.state = trainer.state._replace(params=jax.device_put(bad))
+        with pytest.raises(NonFiniteLossError, match="windowed"):
+            trainer.train(num_steps=12)

@@ -92,6 +92,14 @@ class HParams:
     # trades ~1/3 more FLOPs for O(layers) less activation HBM — for the
     # long-context configs (enc 800+) where activations dominate
     remat: bool = False
+    # Train-loop steps per host->device dispatch (the TPU-idiomatic
+    # steps_per_execution pattern): k>1 runs k optimizer steps as ONE
+    # on-device lax.scan over k stacked batches, cutting host round
+    # trips k-fold — decisive on RPC-proxied backends where every
+    # dispatch pays a tunnel round trip.  Numerically identical to k=1
+    # (same ops, same order).  Checkpoint/metrics cadences quantize to
+    # dispatch boundaries; --debug forces k=1 (step-exact NaN watchdog).
+    steps_per_dispatch: int = 1
     # lax.scan unroll factor for the LSTM encoder / decoder recurrences
     # (pointer-generator family).  The step is LATENCY-bound: ~500
     # sequential scan iterations of small matmuls dominate the 29 ms

@@ -260,7 +260,7 @@ def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, chunk,
         # masked inner scan makes overshooting a chunk a no-op, so the
         # result stays token-exact with both other kinds.
         if chunk is None:  # env fallback, read at trace time
-            chunk = int(os.environ.get("TS_BEAM_CHUNK", "25"))
+            chunk = resolved_chunk("chunked")
         C = min(max(int(chunk), 1), T)
 
         def chunk_body(s):
@@ -329,7 +329,9 @@ def resolved_chunk(loop: str) -> Optional[int]:
     """The effective chunked inner-scan length, resolved from the env —
     pass this to run_beam_search_jit so the chunk size participates in
     the jit cache key (an env change between calls would otherwise be
-    silently ignored by the cached executable)."""
+    silently ignored by the cached executable).  The 25-step default is
+    mirrored in bench.py::_config_fingerprint, which cannot import this
+    (jax-importing) module — keep the two in sync."""
     if loop != "chunked":
         return None
     return int(os.environ.get("TS_BEAM_CHUNK", "25"))

@@ -242,6 +242,12 @@ class Trainer:
         self.checkpoint_steps = (checkpoint_steps
                                  or getattr(hps, "checkpoint_steps", 0))
         self.state = state if state is not None else init_train_state(hps, vsize)
+        # k train steps per device dispatch (an on-device scan over k
+        # stacked batches — config.py steps_per_dispatch).  --debug pins
+        # k=1: the exact per-step watchdog needs per-dispatch fetches.
+        self.steps_per_dispatch = max(
+            1 if hps.debug else getattr(hps, "steps_per_dispatch", 1), 1)
+        self._multi_step_cache: Dict[int, Callable] = {}
         self.checkpointer = checkpointer
         self.checkpoint_secs = checkpoint_secs
         self.train_dir = train_dir or os.path.join(
@@ -329,36 +335,63 @@ class Trainer:
         finally:
             prefetcher.stop()
 
+    def _multi_step(self, k: int) -> Callable:
+        """k train steps as ONE dispatch: an on-device lax.scan over k
+        batches stacked on a leading axis (steps_per_dispatch — the TPU
+        steps_per_execution pattern; k-fold fewer host round trips).
+        Numerically identical to k sequential dispatches."""
+        fn = self._multi_step_cache.get(k)
+        if fn is None:
+            step_fn = self._step_fn
+
+            def multi(state, stacked):
+                return jax.lax.scan(
+                    lambda s, arrays: step_fn(s, arrays), state, stacked)
+
+            fn = jax.jit(multi, donate_argnums=0)
+            self._multi_step_cache[k] = fn
+        return fn
+
     def _flush_metrics(self, pending, window_dt) -> None:
         """Fetch a window of device-resident metrics in one D2H transfer,
         log + summarize each step, and run the NaN watchdog
         (train.py:107-108 parity, detection deferred <= metrics_every
-        steps unless --debug pins the window to 1)."""
+        steps unless --debug pins the window to 1).
+
+        pending: [(first_step, n_steps, metrics, arrays|None)] — metrics
+        leaves are scalars when n_steps == 1, [n_steps]-vectors from the
+        multi-step scan otherwise."""
         if not pending:
             return
-        fetched = jax.device_get([m for _, m, _ in pending])
-        step_time = window_dt / len(pending)
+        fetched = jax.device_get([m for _, _, m, _ in pending])
+        total = sum(n for _, n, _, _ in pending)
+        step_time = window_dt / max(total, 1)
         log.info("seconds for training step: %.3f (avg over %d)",
-                 step_time, len(pending))
-        for (step, _, arrays), m in zip(pending, fetched):
-            loss = float(m.loss)
-            log.info("loss: %f", loss)
-            scalars = dict(loss=loss, total_loss=float(m.total_loss),
-                           global_norm=float(m.global_norm),
-                           step_time=step_time)
-            if self.hps.coverage:
-                cl = float(m.coverage_loss)
-                log.info("coverage_loss: %f", cl)
-                scalars["coverage_loss"] = cl
-            if not np.isfinite(loss):
-                self._dump_nan_batch(step, arrays)
-                raise NonFiniteLossError(
-                    f"Loss is not finite. Stopping. "
-                    f"(step {step}, loss {loss}; detection is windowed — "
-                    f"up to {self.metrics_every - 1} optimizer steps may "
-                    f"have run past the first bad one; --debug pins the "
-                    f"window to 1 for step-exact detection)")
-            self.writer.scalars(step + 1, **scalars)
+                 step_time, total)
+        for (step0, n, _, arrays), m in zip(pending, fetched):
+            for i in range(n):
+                step = step0 + i
+                pick = (lambda x: x) if n == 1 else (lambda x: x[i])
+                loss = float(pick(m.loss))
+                log.info("loss: %f", loss)
+                scalars = dict(loss=loss,
+                               total_loss=float(pick(m.total_loss)),
+                               global_norm=float(pick(m.global_norm)),
+                               step_time=step_time)
+                if self.hps.coverage:
+                    cl = float(pick(m.coverage_loss))
+                    log.info("coverage_loss: %f", cl)
+                    scalars["coverage_loss"] = cl
+                if not np.isfinite(loss):
+                    self._dump_nan_batch(step, arrays)
+                    raise NonFiniteLossError(
+                        f"Loss is not finite. Stopping. "
+                        f"(step {step}, loss {loss}; detection is "
+                        f"windowed — up to {self.metrics_every - 1} "
+                        f"optimizer steps may have run past the first "
+                        f"bad one; --debug pins the window to 1 for "
+                        f"step-exact detection)")
+                self.writer.scalars(step + 1, **scalars)
 
     def _dump_nan_batch(self, step: int, arrays) -> None:
         """--debug: persist the batch that produced a non-finite loss
@@ -395,62 +428,104 @@ class Trainer:
         flush_every = max(self.metrics_every, 1)
         # metrics stay on device until flushed; keeping the (tiny) input
         # arrays alongside lets --debug dump the exact offending batch
-        pending = []  # [(step, device_metrics, arrays)]
+        # (--debug forces steps_per_dispatch=1, so arrays are per-step)
+        pending = []  # [(first_step, n_steps, device_metrics, arrays)]
+        pending_steps = 0
         window_t0 = time.time()
         # ONE device sync to learn the resume step; from here the counter
-        # is tracked host-side (+1 per dispatched step) so the loop never
-        # blocks on state.step and dispatch can run ahead of the device
+        # is tracked host-side (+n per dispatch) so the loop never blocks
+        # on state.step and dispatch can run ahead of the device
         step = int(self.state.step)
-        while True:
+        profile_done = False  # one-shot: never restart a finished trace
+        exhausted = False
+        while not exhausted:
             if limit and step >= limit:
                 break
-            item = prefetcher.next_batch()
-            if item is None:
-                if multihost:
-                    raise RuntimeError(
-                        f"batcher exhausted at step {step} before the "
-                        f"num_steps={limit} limit on a multi-host run; "
-                        f"other hosts may still be issuing collectives — "
-                        f"aborting instead of desyncing")
-                log.info("batcher exhausted; stopping training at step %d", step)
+            # k batches per dispatch (steps_per_dispatch), clipped to the
+            # remaining step budget so the limit stays exact
+            k = self.steps_per_dispatch
+            if limit:
+                k = min(k, limit - step)
+            items = []
+            while len(items) < k:
+                item = prefetcher.next_batch()
+                if item is None:
+                    exhausted = True
+                    break
+                items.append(item)
+            if exhausted and (multihost and (limit == 0 or step + len(items)
+                                             < limit)):
+                raise RuntimeError(
+                    f"batcher exhausted at step {step + len(items)} before "
+                    f"the num_steps={limit} limit on a multi-host run; "
+                    f"other hosts may still be issuing collectives — "
+                    f"aborting instead of desyncing")
+            if not items:
+                log.info("batcher exhausted; stopping training at step %d",
+                         step)
                 break
-            batch, arrays = item
-            if profile_dir and not profiling and step == profile_start:
+            if profile_dir and not profiling and not profile_done \
+                    and step >= profile_start:
                 self._flush_metrics(pending, time.time() - window_t0)
                 pending = []
+                pending_steps = 0
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
                 window_t0 = time.time()
                 log.info("profiler trace started -> %s", profile_dir)
+            n = len(items)
             try:
-                self.state, metrics = self._step_fn(self.state, arrays)
+                if n == 1:
+                    _, arrays = items[0]
+                    self.state, metrics = self._step_fn(self.state, arrays)
+                else:
+                    # stack on device: k tiny int/float batch arrays gain
+                    # a leading scan axis (bytes ~ k x the batch, trivial
+                    # next to one dispatch round trip)
+                    arrays = jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(xs),
+                        *[a for _, a in items])
+                    self.state, metrics = self._multi_step(n)(
+                        self.state, arrays)
+                    arrays = None
             except FloatingPointError as e:
-                # jax_debug_nans (--debug) raises inside the step with the
-                # op-level location; still dump the offending batch and
-                # surface the usual watchdog error type
+                # jax_debug_nans (--debug, which pins n=1) raises inside
+                # the step with the op-level location; still dump the
+                # offending batch and surface the watchdog error type
                 self._dump_nan_batch(step, arrays)
                 raise NonFiniteLossError(
                     f"Loss is not finite. Stopping. (step {step}; "
                     f"jax_debug_nans trace above)") from e
-            pending.append((step, metrics,
+            pending.append((step, n, metrics,
                             arrays if self.hps.debug else None))
-            step += 1
-            if len(pending) >= flush_every:
+            prev_step = step
+            step += n
+            pending_steps += n
+            if pending_steps >= flush_every:
                 self._flush_metrics(pending, time.time() - window_t0)
                 pending = []
+                pending_steps = 0
                 window_t0 = time.time()
             if profiling and step > profile_stop:
                 jax.profiler.stop_trace()
                 profiling = False
+                profile_done = True
                 log.info("profiler trace written to %s", profile_dir)
             if self.checkpointer is not None:
-                due = (step % checkpoint_steps == 0) if multihost \
-                    else (time.time() - last_ckpt >= self.checkpoint_secs)
+                if multihost:
+                    # crossed a cadence boundary this dispatch — identical
+                    # arithmetic on every host, so saves stay collective
+                    # even when k does not divide checkpoint_steps
+                    due = (step // checkpoint_steps
+                           ) != (prev_step // checkpoint_steps)
+                else:
+                    due = time.time() - last_ckpt >= self.checkpoint_secs
                 if due:
                     # the save fetches state anyway; fold the metrics
                     # flush into the same sync point
                     self._flush_metrics(pending, time.time() - window_t0)
                     pending = []
+                    pending_steps = 0
                     self.checkpointer.save(self.state)
                     last_ckpt = time.time()
                     window_t0 = time.time()
