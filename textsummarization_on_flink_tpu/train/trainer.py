@@ -327,7 +327,11 @@ class Trainer:
                 "collectives); stream an infinite shuffled pass instead")
         transfer = self._shard_batch if self._shard_batch is not None \
             else jax.device_put
-        prefetcher = DevicePrefetcher(self.batcher, transfer)
+        # depth covers one full multi-step pull plus a batch in flight,
+        # so a k-batch dispatch never starves on the depth-2 default
+        prefetcher = DevicePrefetcher(
+            self.batcher, transfer,
+            depth=max(2, self.steps_per_dispatch + 1))
         try:
             return self._train_steps(limit, last_ckpt, profile_dir,
                                      profile_start, profile_stop,
