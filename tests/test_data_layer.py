@@ -258,3 +258,7 @@ class TestHParams:
         import pytest as _pytest
         with _pytest.raises(ValueError):
             HParams(mode="bogus").validate()
+        with _pytest.raises(ValueError, match="scan_unroll"):
+            HParams(scan_unroll=0).validate()
+        with _pytest.raises(ValueError, match="steps_per_dispatch"):
+            HParams(steps_per_dispatch=0).validate()

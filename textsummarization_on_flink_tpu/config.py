@@ -239,3 +239,9 @@ class HParams:
             raise ValueError(
                 f"sp_attention must be '', 'ring', or 'ulysses', got "
                 f"{self.sp_attention!r}")
+        if self.scan_unroll < 1:
+            raise ValueError(
+                f"scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.steps_per_dispatch < 1:
+            raise ValueError(f"steps_per_dispatch must be >= 1, got "
+                             f"{self.steps_per_dispatch}")
