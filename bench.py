@@ -616,10 +616,17 @@ def bench_attention() -> None:
 
     results = {}
     speedups = []
-    # reference scale (B16 T400 D512) and long-context (T4096 -> blocked)
-    for name, (B, T, D) in {"ref": (16, 400, 512),
-                            "longctx": (4, 4096, 512)}.items():
+    # reference scale (B16 T400 D512) f32 + bf16 encoder streams (the
+    # compute_dtype=bfloat16 train path hands the op bf16 es/ef), and
+    # long-context (T4096 -> blocked kernel)
+    scales = {"ref": (16, 400, 512, False),
+              "ref_bf16": (16, 400, 512, True),
+              "longctx": (4, 4096, 512, False)}
+    for name, (B, T, D, bf16_stream) in scales.items():
         args = make_args(B, T, D)
+        if bf16_stream:
+            args = (args[0].astype(jnp.bfloat16),
+                    args[1].astype(jnp.bfloat16)) + args[2:]
         xla = jax.jit(lambda *a: pa._attention_xla(*a, True))
         if T * D > pa._SIMPLE_KERNEL_MAX_ELEMS:
             kern = jax.jit(lambda *a: pa._attention_pallas_blocked(
