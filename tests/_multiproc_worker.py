@@ -10,10 +10,13 @@ multi-worker tests at all, SURVEY §4; this rebuild claims the capability
 so it must prove it).
 
 Usage (spawned by the test, not by hand):
-    python _multiproc_worker.py <coordinator_port> <process_id> <workdir>
+    python _multiproc_worker.py <port> <process_id> <workdir> [dp,tp]
 
-Writes <workdir>/worker<process_id>.json with everything the parent
-asserts on; exits non-zero on any failure.
+[dp,tp] defaults to "4,1" (pure data parallelism, replicated params —
+the easy checkpoint gather).  "2,2" additionally shards params over the
+tp axis ACROSS the two hosts, so the collective checkpoint gather must
+fetch non-addressable shards (checkpointer.state_to_arrays's
+process_allgather path) — the hard case.
 """
 
 import json
@@ -23,6 +26,8 @@ import sys
 
 def main() -> int:
     port, pid, workdir = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3])
+    dp, tp = (int(x) for x in (sys.argv[4] if len(sys.argv) > 4
+                               else "4,1").split(","))
 
     import jax
     import numpy as np
@@ -53,13 +58,16 @@ def main() -> int:
     assert info["process_count"] == 2, info
     assert info["global_devices"] == 4, info
 
-    # Global batch 8 over dp=4: each host feeds 4 rows of ITS OWN data
-    # (that IS data parallelism — the transfer must not interleave them).
+    # Global batch 8 over the dp axis: each host feeds its own rows
+    # (that IS data parallelism — the transfer must not interleave
+    # them).  With tp>1 the vocab-axis params shard across hosts.
     hps = HParams(batch_size=8, max_enc_steps=6, max_dec_steps=5,
                   min_dec_steps=1, hidden_dim=4, emb_dim=3,
-                  max_oov_buckets=2, vocab_size=0, dp=4,
+                  max_oov_buckets=2, vocab_size=0, dp=dp, tp=tp,
                   log_root=workdir, exp_name="mp")
-    vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+    # 8 words + 4 specials = vocab 12: divisible by tp=2 for the
+    # sharded-projection variant
+    vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "g", "."])
     local_hps = local_batch_hps(hps)
     assert local_hps.batch_size == 4
     # different text per host: host-local batches are NOT replicas

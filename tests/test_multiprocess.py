@@ -39,12 +39,18 @@ def _worker_env() -> dict:
     return env
 
 
-def test_two_process_distributed_train_checkpoint_resume(tmp_path):
+@pytest.mark.parametrize("mesh", ["4,1", "2,2"])
+def test_two_process_distributed_train_checkpoint_resume(tmp_path, mesh):
+    """mesh='4,1': pure dp, replicated params (easy checkpoint gather).
+    mesh='2,2': params tp-shard ACROSS the two hosts, so the collective
+    save must gather non-addressable shards — the hard path of
+    checkpointer.state_to_arrays."""
     port = _free_port()
     env = _worker_env()
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(port), str(pid), str(tmp_path)],
+            [sys.executable, _WORKER, str(port), str(pid), str(tmp_path),
+             mesh],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True)
         for pid in (0, 1)

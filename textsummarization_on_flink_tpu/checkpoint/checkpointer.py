@@ -205,12 +205,17 @@ class Checkpointer:
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  hps: Optional[HParams] = None):
+        from textsummarization_on_flink_tpu.parallel import distributed
+
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.hps = hps
         os.makedirs(directory, exist_ok=True)
-        if hps is not None:  # provenance sidecar, written once, atomically
-            tmp = os.path.join(directory, "hparams.json.tmp")
+        if hps is not None and distributed.is_chief():
+            # provenance sidecar, written once, atomically — chief-only
+            # (every host constructs a Checkpointer on a shared dir; a
+            # shared tmp name would race), pid-suffixed as defense
+            tmp = os.path.join(directory, f"hparams.json.tmp{os.getpid()}")
             with open(tmp, "w", encoding="utf-8") as f:
                 f.write(hps.to_json())
             os.replace(tmp, os.path.join(directory, "hparams.json"))
