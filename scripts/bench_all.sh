@@ -46,6 +46,8 @@ run train_b16_unroll1    BENCH_MODE=train BENCH_UNROLL=1
 run train_b64            BENCH_MODE=train BENCH_BATCH=64
 run train_scaled         BENCH_MODE=train BENCH_PRESET=scaled
 run train_transformer    BENCH_MODE=train BENCH_FAMILY=transformer
+run trainer_e2e          BENCH_MODE=trainer
+run trainer_e2e_spd1     BENCH_MODE=trainer BENCH_SPD=1
 run decode_b4            BENCH_MODE=decode
 run decode_chunked       BENCH_MODE=decode TS_BEAM_LOOP=chunked
 run decode_transformer   BENCH_MODE=decode BENCH_FAMILY=transformer

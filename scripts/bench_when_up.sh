@@ -32,7 +32,8 @@ for line in open("BENCH_ALL.jsonl"):
         continue
     latest[rec.get("run") or rec.get("metric", "?")] = rec
 tags = ["train_b16", "train_b16_pallas", "train_b16_unroll1", "train_b64",
-        "train_scaled", "train_transformer", "decode_b4", "decode_chunked",
+        "train_scaled", "train_transformer", "trainer_e2e",
+        "trainer_e2e_spd1", "decode_b4", "decode_chunked",
         "decode_transformer", "attention_ab", "flash_ab", "input_pipeline"]
 bad = [t for t in tags
        if t not in latest or "error" in latest[t] or latest[t].get("stale")]
