@@ -25,6 +25,10 @@ touch "$OUT"
 # the stale fallback must read the SAME file this sweep writes
 export BENCH_STALE_FILE="$OUT"
 
+# one attempt per row: the bench_when_up.sh watcher retries whole
+# passes, so per-row retries would just slow a dead-tunnel pass down
+export BENCH_ATTEMPTS="${BENCH_ATTEMPTS:-1}"
+
 run() {
   local tag="$1"; shift
   echo "== $tag" >&2
@@ -37,6 +41,16 @@ rec = json.loads(sys.stdin.read()); rec['run'] = '$tag'
 print(json.dumps(rec))" >> "$OUT"
   else
     echo "{\"run\": \"$tag\", \"error\": \"no output\"}" >> "$OUT"
+  fi
+  # a timed-out row usually means the tunnel died mid-sweep; probe once
+  # and abort the pass early if so (the watcher retries the whole pass —
+  # burning 10-20 min per remaining row on a dead tunnel helps no one)
+  if printf '%s' "$line" | grep -q "timed out"; then
+    if ! timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1
+    then
+      echo "[sweep] tunnel down after '$tag' — aborting this pass" >&2
+      exit 3
+    fi
   fi
 }
 
