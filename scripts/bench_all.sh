@@ -24,6 +24,9 @@ cd "$(dirname "$0")/.."
 touch "$OUT"
 # the stale fallback must read the SAME file this sweep writes
 export BENCH_STALE_FILE="$OUT"
+# successful rows reach $OUT only through bench.py's self-append; an
+# inherited opt-out would silently discard every measured row
+unset BENCH_NO_RECORD
 
 # one attempt per row: the bench_when_up.sh watcher retries whole
 # passes, so per-row retries would just slow a dead-tunnel pass down
@@ -33,14 +36,21 @@ run() {
   local tag="$1"; shift
   echo "== $tag" >&2
   local line
-  line="$(env "$@" python bench.py 2>/dev/null | tail -1)"
-  if [ -n "$line" ]; then
+  # bench.py itself appends successful records (run-tagged via
+  # BENCH_RUN_TAG) to $OUT — single writer, so an interrupted sweep can
+  # never lose a banked number.  The sweep only appends error/stale
+  # stubs, which the watcher's completeness check keys off.
+  line="$(env BENCH_RUN_TAG="$tag" "$@" python bench.py 2>/dev/null | tail -1)"
+  if [ -z "$line" ]; then
+    echo "{\"run\": \"$tag\", \"error\": \"no output\"}" >> "$OUT"
+  elif printf '%s\n' "$line" | python -c "
+import json,sys
+rec = json.loads(sys.stdin.read())
+sys.exit(0 if ('error' in rec or rec.get('stale')) else 1)" 2>/dev/null; then
     printf '%s\n' "$line" | python -c "
 import json,sys
 rec = json.loads(sys.stdin.read()); rec['run'] = '$tag'
 print(json.dumps(rec))" >> "$OUT"
-  else
-    echo "{\"run\": \"$tag\", \"error\": \"no output\"}" >> "$OUT"
   fi
   # a timed-out row usually means the tunnel died mid-sweep; probe once
   # and abort the pass early if so (the watcher retries the whole pass —

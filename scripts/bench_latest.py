@@ -26,8 +26,21 @@ def latest_by_tag(path):
             except ValueError:
                 continue
             tag = rec.get("run") or rec.get("metric", "?")
-            latest[tag] = rec  # file order == capture order: last wins
+            # newest captured_at wins (ISO-8601 UTC sorts lexically);
+            # interleaved appends from concurrent/interrupted sweeps can
+            # put older records later in the file, so position alone is
+            # not trustworthy.  A stale re-emission copies its source's
+            # captured_at, so on timestamp ties a live record beats a
+            # stale one; full ties (and stamp-less legacy lines, tying
+            # at "") fall back to file order.
+            old = latest.get(tag)
+            if old is None or _recency(rec) >= _recency(old):
+                latest[tag] = rec
     return latest
+
+
+def _recency(rec):
+    return (str(rec.get("captured_at", "")), 0 if rec.get("stale") else 1)
 
 
 def main(argv):

@@ -226,6 +226,61 @@ def test_stale_fallback_platform_and_stale_guards(tmp_path, monkeypatch):
     assert rec is not None and rec["value"] == 2.0
 
 
+def test_stale_fallback_newest_by_captured_at_not_file_order(tmp_path,
+                                                             monkeypatch):
+    """Interleaved appends (concurrent or interrupted sweeps) can put an
+    older record later in the file; captured_at must win over position."""
+    monkeypatch.setenv("BENCH_MODE", "train")
+    for var in ("BENCH_BATCH", "BENCH_PRESET", "BENCH_FAMILY",
+                "TS_PALLAS", "BENCH_PLATFORM"):
+        monkeypatch.delenv(var, raising=False)
+    fp = bench._config_fingerprint()
+    path = tmp_path / "BENCH_ALL.jsonl"
+    _write_jsonl(path, [
+        {"metric": "train_samples_per_sec", "value": 600.0,
+         "config_fingerprint": fp, "captured_at": "2026-07-30T09:00:00Z"},
+        # appended later but captured EARLIER: must lose
+        {"metric": "train_samples_per_sec", "value": 500.0,
+         "config_fingerprint": fp, "captured_at": "2026-07-30T07:00:00Z"},
+    ])
+    monkeypatch.setenv("BENCH_STALE_FILE", str(path))
+    rec = bench._stale_fallback("train_samples_per_sec", "x")
+    assert rec is not None and rec["value"] == 600.0
+
+
+def test_supervisor_records_success_to_jsonl(tmp_path):
+    """VERDICT r3 missing#4: a SUCCESSFUL supervised run must append its
+    record (fingerprint + captured_at + run tag) to the shared JSONL so
+    any tunnel-window measurement becomes permanent fallback material.
+    Uses the host-only input mode so no TPU is needed."""
+    import json
+    import subprocess
+
+    path = tmp_path / "BENCH_ALL.jsonl"
+    env = dict(os.environ)
+    for var in ("TS_BENCH_CHILD", "BENCH_BATCH", "BENCH_PRESET",
+                "BENCH_FAMILY", "TS_PALLAS", "BENCH_NO_RECORD"):
+        env.pop(var, None)
+    env.update(BENCH_MODE="input", BENCH_PRESET="tiny", BENCH_SECONDS="0.5",
+               BENCH_BATCH="4", BENCH_ATTEMPTS="1", BENCH_TIMEOUT="110",
+               BENCH_STALE_FILE=str(path), BENCH_RUN_TAG="input_pipeline")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=150)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    printed = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert printed["value"] > 0
+    lines = [json.loads(s) for s in
+             path.read_text().strip().splitlines()]
+    assert len(lines) == 1
+    rec = lines[0]
+    assert rec == printed
+    assert rec["run"] == "input_pipeline"
+    assert rec["config_fingerprint"]["mode"] == "input"
+    assert "captured_at" in rec
+
+
 def test_supervisor_emits_stale_record_when_tunnel_down(tmp_path):
     """End to end through the real supervisor: child times out, stale
     record on disk, one parseable JSON line with stale:true on stdout and
