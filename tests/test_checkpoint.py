@@ -1,6 +1,7 @@
 """Checkpoint lifecycle tests: retention, best-model, surgery, inspector,
 TF1 import mapping."""
 
+import json
 import os
 
 import jax
@@ -45,6 +46,21 @@ def test_save_restore_roundtrip(tmp_path, state):
     for a, b in zip(jax.tree_util.tree_leaves(state),
                     jax.tree_util.tree_leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hparams_sidecar_written_on_first_save_not_construction(
+        tmp_path, state):
+    """ADVICE r3: the constructor is filesystem-only (consulting
+    is_chief there would force JAX backend init, which can hang on a
+    down TPU tunnel); the provenance sidecar lands with the first
+    save."""
+    ck = Checkpointer(str(tmp_path), hps=tiny_hps())
+    sidecar = os.path.join(str(tmp_path), "hparams.json")
+    assert not os.path.exists(sidecar)
+    ck.save(state)
+    assert os.path.exists(sidecar)
+    with open(sidecar, encoding="utf-8") as f:
+        assert json.load(f)["hidden_dim"] == tiny_hps().hidden_dim
 
 
 def test_retention_keeps_three(tmp_path, state):

@@ -205,20 +205,26 @@ class Checkpointer:
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  hps: Optional[HParams] = None):
-        from textsummarization_on_flink_tpu.parallel import distributed
-
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.hps = hps
         os.makedirs(directory, exist_ok=True)
-        if hps is not None and distributed.is_chief():
-            # provenance sidecar, written once, atomically — chief-only
-            # (every host constructs a Checkpointer on a shared dir; a
-            # shared tmp name would race), pid-suffixed as defense
-            tmp = os.path.join(directory, f"hparams.json.tmp{os.getpid()}")
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.write(hps.to_json())
-            os.replace(tmp, os.path.join(directory, "hparams.json"))
+        # the provenance sidecar is written on the first save(), not here:
+        # consulting is_chief() would force JAX backend init inside a
+        # filesystem-only constructor (it can hang on a down TPU tunnel,
+        # and before jax.distributed.initialize every host believes it is
+        # process 0) — ADVICE r3
+        self._sidecar_pending = hps is not None
+
+    def _write_sidecar(self) -> None:
+        # written once, atomically — chief-only (every host constructs a
+        # Checkpointer on a shared dir; a shared tmp name would race),
+        # pid-suffixed as defense
+        tmp = os.path.join(self.directory, f"hparams.json.tmp{os.getpid()}")
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(self.hps.to_json())
+        os.replace(tmp, os.path.join(self.directory, "hparams.json"))
+        self._sidecar_pending = False
 
     def save(self, state: TrainState) -> str:
         """Multi-host: EVERY host must call this (the shard gather inside
@@ -231,6 +237,8 @@ class Checkpointer:
         path = os.path.join(self.directory, f"{CKPT_PREFIX}-{step}.npz")
         if not distributed.is_chief():
             return path
+        if self._sidecar_pending:
+            self._write_sidecar()
         save_arrays(path, flat)
         _write_index(self.directory, path, INDEX_FILE)
         self._retain()

@@ -84,9 +84,10 @@ class HParams:
     # metrics fetch cadence in steps (one blocking D2H sync per window);
     # 0 = auto: 1 under --debug, 10 otherwise
     metrics_every: int = 0
-    # multi-host checkpoint cadence in STEPS (collective saves must fire
-    # at the same step on every host); 0 on a multi-host run falls back
-    # to reinterpreting the 60s save_model_secs as a step count, loudly
+    # checkpoint cadence in STEPS; REQUIRED (>0) on multi-host runs with
+    # a checkpointer (collective saves must fire at the same step on
+    # every host — Trainer hard-errors otherwise); 0 on single-host
+    # keeps the wall-clock save_model_secs cadence
     checkpoint_steps: int = 0
     # rematerialize transformer layers in backward (jax.checkpoint):
     # trades ~1/3 more FLOPs for O(layers) less activation HBM — for the
