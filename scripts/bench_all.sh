@@ -16,10 +16,10 @@ OUT="${1:-BENCH_ALL.jsonl}"
 case "$OUT" in /*) ;; *) OUT="$PWD/$OUT" ;; esac  # resolve before the cd
 cd "$(dirname "$0")/.."
 # APPEND, never truncate: bench.py's stale fallback serves the NEWEST
-# matching record (file order == capture order), so older lines are
-# harmless — but truncating would destroy the very records the fallback
-# needs if the tunnel drops mid-sweep.  Each record carries captured_at
-# + config_fingerprint; summarize the latest per tag with
+# matching record (max captured_at; live beats stale on ties), so older
+# lines are harmless — but truncating would destroy the very records the
+# fallback needs if the tunnel drops mid-sweep.  Each record carries
+# captured_at + config_fingerprint; summarize the latest per tag with
 # scripts/bench_latest.py.
 touch "$OUT"
 # the stale fallback must read the SAME file this sweep writes
@@ -51,6 +51,12 @@ sys.exit(0 if ('error' in rec or rec.get('stale')) else 1)" 2>/dev/null; then
 import json,sys
 rec = json.loads(sys.stdin.read()); rec['run'] = '$tag'
 print(json.dumps(rec))" >> "$OUT"
+  elif ! grep -qF "$line" "$OUT"; then
+    # bench.py appends successes itself, printing the identical JSON it
+    # recorded — if the line is missing, the self-append failed (its
+    # stderr warning was discarded above); do not lose the measurement
+    echo "[sweep] self-append missing for '$tag'; appending fallback" >&2
+    printf '%s\n' "$line" >> "$OUT"
   fi
   # a timed-out row usually means the tunnel died mid-sweep; probe once
   # and abort the pass early if so (the watcher retries the whole pass —

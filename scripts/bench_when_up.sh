@@ -8,6 +8,9 @@ set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${1:-120}"
 unset BENCH_NO_RECORD  # banked rows reach the JSONL via bench.py's append
+# an inherited override (e.g. from an ad-hoc probe) would divert the
+# banked headline row away from the BENCH_ALL.jsonl this watcher checks
+unset BENCH_STALE_FILE
 rm -f BENCH_SWEEP_DONE
 while true; do
   echo "[watch] $(date -u +%H:%M:%S) probing tunnel..."
@@ -29,20 +32,18 @@ while true; do
     # one definition of "newest record per tag": bench_latest.py
     # (max captured_at, live beats stale on ties) — so a live row banked
     # earlier in this window counts even if a later re-run timed out
-    if python scripts/bench_latest.py BENCH_ALL.jsonl --json | python - <<'PYEOF'
-import json, sys
-latest = {}
-for line in sys.stdin:
-    line = line.strip()
-    if line:
-        rec = json.loads(line)
-        latest[rec.get("run") or rec.get("metric", "?")] = rec
+    if python - <<'PYEOF'
+import sys
+sys.path.insert(0, "scripts")
+from bench_latest import latest_by_tag  # ONE definition of newest-per-tag
+
+live = {tag for tag, rec in latest_by_tag("BENCH_ALL.jsonl").items()
+        if "error" not in rec and not rec.get("stale")}
 tags = ["train_b16", "train_b16_pallas", "train_b16_unroll1", "train_b64",
         "train_scaled", "train_transformer", "trainer_e2e",
         "trainer_e2e_spd1", "decode_b4", "decode_chunked",
         "decode_transformer", "attention_ab", "flash_ab", "input_pipeline"]
-bad = [t for t in tags
-       if t not in latest or "error" in latest[t] or latest[t].get("stale")]
+bad = [t for t in tags if t not in live]
 if bad:
     print(f"[watch] incomplete sweep rows: {bad}", file=sys.stderr)
     sys.exit(1)
