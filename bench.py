@@ -154,10 +154,15 @@ def _config_fingerprint() -> dict:
         loop = (os.environ.get("TS_BEAM_LOOP", "auto") or "auto").lower()
         fp["beam_loop"] = loop
         if loop == "chunked":
-            # default mirrors beam_search.resolved_chunk (this supervisor
-            # must not import jax-importing modules: with the axon plugin
-            # on PYTHONPATH and the tunnel down, jax import hangs)
-            fp["chunk"] = int(os.environ.get("TS_BEAM_CHUNK", "25"))
+            # same env resolution beam_search.resolved_chunk uses; lives
+            # in config.py because this supervisor must not import
+            # jax-importing modules (with the axon plugin on PYTHONPATH
+            # and the tunnel down, jax import hangs)
+            from textsummarization_on_flink_tpu.config import (
+                beam_chunk_from_env,
+            )
+
+            fp["chunk"] = beam_chunk_from_env()
     elif mode == "flash":
         fp["flash_t"] = int(os.environ.get("BENCH_FLASH_T", "2048"))
     elif mode == "input":

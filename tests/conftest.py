@@ -31,3 +31,11 @@ try:
     _native_build.build()
 except Exception:  # noqa: BLE001 — optional dependency, skip-gated tests
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test (>=20s: multiprocess runs, dryruns, "
+        "full-scale compiles).  Fast iteration: -m 'not slow' (~half the "
+        "suite wall clock); the full suite gates round-end.")

@@ -128,6 +128,7 @@ def cli_argv(tmp_path, mode, **kw):
     return [f"--{k}={v}" for k, v in base.items()]
 
 
+@pytest.mark.slow
 def test_cli_train_then_eval_then_decode(data_env):
     assert cli.main(cli_argv(data_env, "train", num_steps=2,
                              single_pass=True)) == 0

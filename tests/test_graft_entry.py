@@ -1,6 +1,7 @@
 """Driver-hook smoke tests: entry() traces, dryrun_multichip executes."""
 
 import jax
+import pytest
 
 import __graft_entry__ as ge
 
@@ -13,12 +14,14 @@ def test_entry_traces():
     assert lowered is not None
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8(monkeypatch):
     # the exact path the driver takes: scrubbed-env subprocess re-exec
     monkeypatch.delenv("TS_DRYRUN_INPROC", raising=False)
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_1(monkeypatch):
     # in-process body (the conftest already pins the virtual CPU mesh)
     monkeypatch.setenv("TS_DRYRUN_INPROC", "1")

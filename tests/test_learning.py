@@ -39,6 +39,7 @@ def _decode_first_words(state, hps, vocab, exs):
 
 
 @pytest.mark.parametrize("family", ["pointer_generator", "transformer"])
+@pytest.mark.slow
 def test_learns_oov_copy_through(family):
     """The defining pointer capability: decoded output contains words that
     are NOT in the vocabulary — reachable only through the extended-vocab
@@ -72,6 +73,7 @@ def test_learns_oov_copy_through(family):
     assert hits >= 7, f"{family} copied the OOV entity in only {hits}/8"
 
 
+@pytest.mark.slow
 def test_two_phase_coverage_recipe(tmp_path):
     """The reference's training recipe as ONE flow (SURVEY §5.4): train
     without coverage, convert the checkpoint (fresh w_c + accumulator),
@@ -134,6 +136,7 @@ def family_hps(family: str) -> HParams:
 
 
 @pytest.mark.parametrize("family", ["pointer_generator", "transformer"])
+@pytest.mark.slow
 def test_learns_copy_task(family):
     hps = family_hps(family)
     vocab = Vocab(words=WORDS, max_size=hps.vocab_size)

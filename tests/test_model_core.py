@@ -318,6 +318,7 @@ def test_bf16_forward_close_to_f32():
                                atol=1e-3)
 
 
+@pytest.mark.slow
 def test_pg_remat_gradient_parity():
     """--remat recomputes the hoisted [T_dec, B, V] scores tensor in
     backward instead of holding it as a residual (ADVICE r2: the

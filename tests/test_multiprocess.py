@@ -40,6 +40,7 @@ def _worker_env() -> dict:
 
 
 @pytest.mark.parametrize("mesh", ["4,1", "2,2"])
+@pytest.mark.slow
 def test_two_process_distributed_train_checkpoint_resume(tmp_path, mesh):
     """mesh='4,1': pure dp, replicated params (easy checkpoint gather).
     mesh='2,2': params tp-shard ACROSS the two hosts, so the collective

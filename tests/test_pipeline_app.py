@@ -28,6 +28,7 @@ def tiny_hps(tmp_path, mode, **kw):
     return HParams(**base)
 
 
+@pytest.mark.slow
 def test_app_main_train_then_serve(tmp_path):
     vocab = Vocab(words=WORDS)
     app = app_lib.App(train_hps=tiny_hps(tmp_path, "train", num_steps=2),
@@ -44,6 +45,7 @@ def test_app_main_train_then_serve(tmp_path):
         assert isinstance(summary, str)
 
 
+@pytest.mark.slow
 def test_app_inference_from_model_json(tmp_path):
     vocab = Vocab(words=WORDS)
     app = app_lib.App(train_hps=tiny_hps(tmp_path, "train", num_steps=1),
@@ -69,6 +71,7 @@ def test_default_hps_match_reference_app():
     assert app_lib.OUTPUT_TOPIC == "flink_output"
 
 
+@pytest.mark.slow
 def test_streaming_latency_timed_source(tmp_path):
     """SourceSinkTest.java parity: a trickle stream must yield each result
     promptly — a row's summary cannot wait for later rows to arrive

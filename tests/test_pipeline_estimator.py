@@ -71,6 +71,7 @@ def test_reference_to_abstract_wraps_sentences():
     assert a.count("<s>") == 2 and a.count("</s>") == 2
 
 
+@pytest.mark.slow
 def test_inference_after_training(tmp_path, vocab):
     source = CollectionSource(article_rows())
     model = make_estimator(tmp_path, vocab).fit(source)
@@ -89,6 +90,7 @@ def test_inference_after_training(tmp_path, vocab):
         assert reference.startswith("reference")
 
 
+@pytest.mark.slow
 def test_json_export_import(tmp_path, vocab):
     source = CollectionSource(article_rows())
     model = make_estimator(tmp_path, vocab).fit(source)
@@ -103,6 +105,7 @@ def test_json_export_import(tmp_path, vocab):
     assert len(sink.rows) == 3
 
 
+@pytest.mark.slow
 def test_pipeline_estimator_and_model_single_job(tmp_path, vocab):
     """Pipeline(estimator) -> fit -> transform in one process — the
     one-TFUtils-call-per-job blocker does not exist here."""
@@ -113,6 +116,7 @@ def test_pipeline_estimator_and_model_single_job(tmp_path, vocab):
     assert len(sink.rows) == 4
 
 
+@pytest.mark.slow
 def test_training_resumes_from_checkpoint(tmp_path, vocab):
     est = make_estimator(tmp_path, vocab)
     est.fit(CollectionSource(article_rows()))

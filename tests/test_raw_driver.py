@@ -11,6 +11,7 @@ WORDS = ("article reference the quick brown fox jumped over lazy dog "
          "0 1 2 3 4 5 6 7").split()
 
 
+@pytest.mark.slow
 def test_raw_training_then_inference(tmp_path):
     vocab = Vocab(words=WORDS)
     rows = [(f"uuid-{i}", f"article {i} .", "", f"reference {i} .")

@@ -334,6 +334,7 @@ class TestStepsPerDispatch:
         recs = [json_lib.loads(l) for l in open(path)]
         return state, recs
 
+    @pytest.mark.slow
     def test_k4_matches_k1(self, tmp_path):
         s1, r1 = self._run(tmp_path, 1)
         s4, r4 = self._run(tmp_path, 4)

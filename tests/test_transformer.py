@@ -211,6 +211,7 @@ def test_flash_branch_matches_einsum_interpret(monkeypatch):
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_flash_grad_parity_bench_scale(monkeypatch):
     """The EXACT correctness gate bench.py's flash mode runs on hardware
     (fwd+bwd through a masked sum-of-squares loss at T=2048), executed in
@@ -247,6 +248,7 @@ def test_flash_grad_parity_bench_scale(monkeypatch):
     assert err < 1e-6  # and far tighter in practice (observed ~3e-9)
 
 
+@pytest.mark.slow
 def test_remat_gradient_parity(setup):
     """--remat recomputes layer activations in backward; gradients must
     match the stored-activation path (up to FP reassociation)."""
@@ -308,6 +310,7 @@ def test_coverage_conversion_rejects_transformer(setup, tmp_path):
 
 
 @pytest.mark.parametrize("dp,tp,sp", [(8, 1, 1), (2, 2, 2)])
+@pytest.mark.slow
 def test_sharded_train_step_matches_single_device(setup, dp, tp, sp):
     hps, vocab, batch, state = setup
     single = jax.jit(trainer_lib.make_train_step(hps))
@@ -395,6 +398,7 @@ def test_ulysses_attention_op_matches_full_attention():
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_ulysses_sharded_step_matches_single_device(setup):
     """Full transformer train step with --sp_attention=ulysses under a
     (dp=2, sp=4) mesh == the single-device step (num_heads=4 % sp ok)."""

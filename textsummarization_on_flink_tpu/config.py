@@ -246,3 +246,17 @@ class HParams:
         if self.steps_per_dispatch < 1:
             raise ValueError(f"steps_per_dispatch must be >= 1, got "
                              f"{self.steps_per_dispatch}")
+
+
+def beam_chunk_from_env() -> int:
+    """Effective TS_BEAM_CHUNK for the chunked beam-decode loop.
+
+    The SINGLE source of the 25-step default: decode/beam_search.py
+    resolves the jit cache key through this, and bench.py's config
+    fingerprint (which must stay importable without jax) records it —
+    a drift between the two would let a measurement under one chunk
+    size stand in for another.
+    """
+    import os
+
+    return int(os.environ.get("TS_BEAM_CHUNK", "25"))

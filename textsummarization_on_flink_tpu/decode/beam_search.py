@@ -329,12 +329,14 @@ def resolved_chunk(loop: str) -> Optional[int]:
     """The effective chunked inner-scan length, resolved from the env —
     pass this to run_beam_search_jit so the chunk size participates in
     the jit cache key (an env change between calls would otherwise be
-    silently ignored by the cached executable).  The 25-step default is
-    mirrored in bench.py::_config_fingerprint, which cannot import this
-    (jax-importing) module — keep the two in sync."""
+    silently ignored by the cached executable).  The default lives in
+    config.beam_chunk_from_env (single source, shared with bench.py's
+    config fingerprint)."""
     if loop != "chunked":
         return None
-    return int(os.environ.get("TS_BEAM_CHUNK", "25"))
+    from textsummarization_on_flink_tpu.config import beam_chunk_from_env
+
+    return beam_chunk_from_env()
 
 
 def run_beam_search(params, hps: HParams, arrays: Dict[str, np.ndarray],
