@@ -1,0 +1,64 @@
+"""Roofline-report tool tests (scripts/roofline.py): the XLA cost-model
+numbers must exist, be self-consistent, and agree with bench.py's
+analytic FLOPs model to within fusion/backward-counting slack — the
+cross-check that keeps the MFU denominator honest."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+spec = importlib.util.spec_from_file_location(
+    "roofline", os.path.join(os.path.dirname(__file__), "..", "scripts",
+                             "roofline.py"))
+roofline = importlib.util.module_from_spec(spec)
+sys.modules["roofline"] = roofline
+spec.loader.exec_module(roofline)
+
+
+def test_tiny_config_costs_are_consistent():
+    bench_mod = roofline._load_bench()
+    rec = roofline.analyze("train_tiny", "v5e", bench_mod, None)
+    assert rec["xla_flops"] > 0
+    assert rec["bytes_accessed"] > 0
+    # XLA counts every op (elementwise, softmax, full backward as
+    # written); the analytic model is matmul MACs x3.  They must agree
+    # to within fusion/counting slack, not orders of magnitude.
+    assert 0.5 <= rec["flops_ratio_xla_over_analytic"] <= 6.0, rec
+    # floors: min_step is the max of the two floors, and samples/s match
+    assert rec["min_step_ms"] == max(rec["compute_floor_ms"],
+                                     rec["bandwidth_floor_ms"])
+    assert rec["max_samples_per_sec"] > 0
+    assert rec["bound"] in ("bandwidth", "compute")
+
+
+def test_measured_join_uses_live_records_only(tmp_path):
+    path = tmp_path / "BENCH_ALL.jsonl"
+    rows = [
+        {"metric": "train_samples_per_sec", "run": "train_b16",
+         "value": 600.0, "step_time_ms": 26.7,
+         "captured_at": "2026-07-30T10:00:00Z"},
+        {"metric": "train_samples_per_sec", "run": "train_b64",
+         "value": 0.0, "error": "timed out"},
+        {"metric": "train_samples_per_sec", "run": "train_scaled",
+         "value": 300.0, "step_time_ms": 50.0, "stale": True,
+         "captured_at": "2026-07-30T09:00:00Z"},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    m = roofline.measured_rows(str(path))
+    assert set(m) == {"train_b16"}  # error + stale rows excluded
+    assert m["train_b16"]["step_time_ms"] == 26.7
+    assert roofline.measured_rows(str(tmp_path / "missing.jsonl")) == {}
+
+
+@pytest.mark.slow
+def test_cli_json_smoke(capsys):
+    rc = roofline.main(["--configs", "train_tiny", "--json",
+                        "--bench", "/nonexistent"])
+    assert rc == 0
+    out = [json.loads(l) for l in
+           capsys.readouterr().out.strip().splitlines()]
+    assert out and out[0]["config"] == "train_tiny"
+    assert "measured_step_ms" not in out[0]
