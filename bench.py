@@ -112,6 +112,12 @@ def _child_env() -> dict:
     return env
 
 
+def _env_flag(name: str) -> bool:
+    """Boolean env knob: '1'/'on'/'true'/'yes' enable (so '=0' really
+    disables — raw truthiness would read '0' as on)."""
+    return os.environ.get(name, "").lower() in ("1", "on", "true", "yes")
+
+
 def _config_fingerprint() -> dict:
     """The config axes that distinguish one sweep row from another, as
     seen from the environment.  Successful records embed this; the stale
@@ -132,6 +138,10 @@ def _config_fingerprint() -> dict:
         fp["preset"] = os.environ.get("BENCH_PRESET", "ref") or "ref"
         fp["family"] = (os.environ.get("BENCH_FAMILY", "")
                         or "pointer_generator")
+        if mode in ("train", "trainer"):
+            # remat trades recompute for bytes — a different program; a
+            # remat measurement must never stand in for a non-remat ask
+            fp["remat"] = _env_flag("BENCH_REMAT")
         # record the RESOLVED kernel choice, not the raw env string:
         # "auto"'s meaning changed once (pallas-on-tpu -> xla), and a
         # fingerprint of intent would cross-substitute semantically
@@ -448,6 +458,11 @@ def _preset_overrides() -> dict:
         out.update(hidden_dim=512, max_enc_steps=800)
     if os.environ.get("BENCH_UNROLL"):
         out["scan_unroll"] = int(os.environ["BENCH_UNROLL"])
+    if _env_flag("BENCH_REMAT"):
+        # roofline-motivated A/B (BASELINE.md): on a bandwidth-bound step
+        # recomputing the [T_dec, B, V] scores block in backward may SAVE
+        # time, not just memory
+        out["remat"] = True
     family = os.environ.get("BENCH_FAMILY", "")
     if family:
         out["model_family"] = family

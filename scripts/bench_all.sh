@@ -73,6 +73,7 @@ print(json.dumps(rec))" >> "$OUT"
 run train_b16            BENCH_MODE=train
 run train_b16_pallas     BENCH_MODE=train TS_PALLAS=on
 run train_b16_unroll1    BENCH_MODE=train BENCH_UNROLL=1
+run train_b16_remat      BENCH_MODE=train BENCH_REMAT=1
 run train_b64            BENCH_MODE=train BENCH_BATCH=64
 run train_scaled         BENCH_MODE=train BENCH_PRESET=scaled
 run train_transformer    BENCH_MODE=train BENCH_FAMILY=transformer
@@ -80,6 +81,7 @@ run trainer_e2e          BENCH_MODE=trainer
 run trainer_e2e_spd1     BENCH_MODE=trainer BENCH_SPD=1
 run decode_b4            BENCH_MODE=decode
 run decode_chunked       BENCH_MODE=decode TS_BEAM_LOOP=chunked
+run decode_while         BENCH_MODE=decode TS_BEAM_LOOP=while
 run decode_transformer   BENCH_MODE=decode BENCH_FAMILY=transformer
 run attention_ab         BENCH_MODE=attention
 run flash_ab             BENCH_MODE=flash

@@ -33,16 +33,18 @@ while true; do
     # (max captured_at, live beats stale on ties) — so a live row banked
     # earlier in this window counts even if a later re-run timed out
     if python - <<'PYEOF'
+import re
 import sys
 sys.path.insert(0, "scripts")
 from bench_latest import latest_by_tag  # ONE definition of newest-per-tag
 
 live = {tag for tag, rec in latest_by_tag("BENCH_ALL.jsonl").items()
         if "error" not in rec and not rec.get("stale")}
-tags = ["train_b16", "train_b16_pallas", "train_b16_unroll1", "train_b64",
-        "train_scaled", "train_transformer", "trainer_e2e",
-        "trainer_e2e_spd1", "decode_b4", "decode_chunked",
-        "decode_transformer", "attention_ab", "flash_ab", "input_pipeline"]
+# the sweep script's run lines ARE the tag list (single source: a row
+# added there is automatically required here)
+tags = re.findall(r"^run\s+(\S+)", open("scripts/bench_all.sh").read(),
+                  re.M)
+assert tags, "no run lines found in scripts/bench_all.sh"
 bad = [t for t in tags if t not in live]
 if bad:
     print(f"[watch] incomplete sweep rows: {bad}", file=sys.stderr)

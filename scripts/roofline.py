@@ -61,6 +61,7 @@ CHIPS = {
 # flop/byte counts are unroll-invariant).
 CONFIGS = {
     "train_b16": {},
+    "train_b16_remat": {"BENCH_REMAT": "1"},
     "train_b64": {"BENCH_BATCH": "64"},
     "train_scaled": {"BENCH_PRESET": "scaled"},
     "train_transformer": {"BENCH_FAMILY": "transformer"},
@@ -69,7 +70,7 @@ CONFIGS = {
 }
 
 _BENCH_ENV_VARS = ("BENCH_BATCH", "BENCH_PRESET", "BENCH_FAMILY",
-                   "BENCH_UNROLL")
+                   "BENCH_UNROLL", "BENCH_REMAT")
 
 
 def hps_for(tag: str, bench_mod):
@@ -165,7 +166,8 @@ def measured_rows(path: str) -> dict:
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    default_cfgs = "train_b16,train_b64,train_scaled,train_transformer"
+    default_cfgs = ("train_b16,train_b16_remat,train_b64,train_scaled,"
+                    "train_transformer")
     ap.add_argument("--configs", default=default_cfgs)
     ap.add_argument("--chip", default="v5e", choices=sorted(CHIPS))
     ap.add_argument("--json", action="store_true")

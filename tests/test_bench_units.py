@@ -102,17 +102,21 @@ def test_input_bench_runs_on_host(tmp_path):
 def test_config_fingerprint_distinguishes_sweep_rows(monkeypatch):
     monkeypatch.setenv("BENCH_MODE", "train")
     for var in ("BENCH_BATCH", "BENCH_PRESET", "BENCH_FAMILY",
-                "TS_PALLAS", "BENCH_PLATFORM"):
+                "TS_PALLAS", "BENCH_PLATFORM", "BENCH_REMAT"):
         monkeypatch.delenv(var, raising=False)
     base = bench._config_fingerprint()
     assert base == {"mode": "train", "platform": "tpu", "batch": 16,
                     "preset": "ref", "family": "pointer_generator",
-                    "pallas": "off", "unroll": 8}
+                    "pallas": "off", "unroll": 8, "remat": False}
     monkeypatch.setenv("BENCH_BATCH", "64")
     assert bench._config_fingerprint() != base
     # a CPU smoke record must never satisfy a TPU ask
     monkeypatch.delenv("BENCH_BATCH")
     monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+    assert bench._config_fingerprint() != base
+    # remat is a different compiled program: its row must never stand in
+    monkeypatch.delenv("BENCH_PLATFORM")
+    monkeypatch.setenv("BENCH_REMAT", "1")
     assert bench._config_fingerprint() != base
 
 
@@ -289,7 +293,8 @@ def test_supervisor_emits_stale_record_when_tunnel_down(tmp_path):
     import subprocess
 
     fp = {"mode": "train", "platform": "cpu", "batch": 16, "preset": "ref",
-          "family": "pointer_generator", "pallas": "off", "unroll": 8}
+          "family": "pointer_generator", "remat": False, "pallas": "off",
+          "unroll": 8}
     path = tmp_path / "BENCH_ALL.jsonl"
     _write_jsonl(path, [
         {"metric": "train_samples_per_sec", "value": 552.8,
@@ -300,7 +305,7 @@ def test_supervisor_emits_stale_record_when_tunnel_down(tmp_path):
     # ambient sweep/config vars would shift the fingerprint away from
     # the hard-coded record above
     for var in ("TS_BENCH_CHILD", "BENCH_BATCH", "BENCH_PRESET",
-                "BENCH_FAMILY", "TS_PALLAS"):
+                "BENCH_FAMILY", "TS_PALLAS", "BENCH_REMAT"):
         env.pop(var, None)
     # a command that can never finish within the timeout stands in for a
     # hung tunnel; BENCH_SLEEP_FOR_TEST makes the child sleep before work
