@@ -148,6 +148,29 @@ def _config_fingerprint() -> dict:
         # different measurements across that change
         pallas_env = (os.environ.get("TS_PALLAS", "") or "auto").lower()
         fp["pallas"] = "on" if pallas_env in ("1", "on", "true") else "off"
+        # transformer flash self-attention routing: record the RESOLVED
+        # kernel choice (same rule as pallas above — an intent
+        # fingerprint would cross-substitute across any future change
+        # to auto's threshold).  The pg family never reads TS_FLASH, so
+        # it always resolves 'off'; auto resolves on the ask's encoder
+        # shape via _use_flash's frozen rule (aligned T>=1024).
+        if fp["family"] != "transformer":
+            fp["flash"] = "off"
+        else:
+            from textsummarization_on_flink_tpu.config import (
+                HParams,
+                flash_mode_from_env,
+            )
+
+            resolved = flash_mode_from_env()
+            if resolved == "auto":
+                hp = HParams(batch_size=fp["batch"],
+                             **_preset_overrides())
+                hd = hp.hidden_dim // hp.num_heads
+                aligned = hp.max_enc_steps % 128 == 0 and hd % 128 == 0
+                resolved = ("on" if aligned and hp.max_enc_steps >= 1024
+                            else "off")
+            fp["flash"] = resolved
         if os.environ.get("BENCH_UNROLL"):
             fp["unroll"] = int(os.environ["BENCH_UNROLL"])
         else:  # the HParams default (config.py is dependency-light)

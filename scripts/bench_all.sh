@@ -77,6 +77,7 @@ run train_b16_remat      BENCH_MODE=train BENCH_REMAT=1
 run train_b64            BENCH_MODE=train BENCH_BATCH=64
 run train_scaled         BENCH_MODE=train BENCH_PRESET=scaled
 run train_transformer    BENCH_MODE=train BENCH_FAMILY=transformer
+run train_transformer_flash BENCH_MODE=train BENCH_FAMILY=transformer TS_FLASH=on
 run trainer_e2e          BENCH_MODE=trainer
 run trainer_e2e_spd1     BENCH_MODE=trainer BENCH_SPD=1
 run decode_b4            BENCH_MODE=decode

@@ -102,12 +102,25 @@ def test_input_bench_runs_on_host(tmp_path):
 def test_config_fingerprint_distinguishes_sweep_rows(monkeypatch):
     monkeypatch.setenv("BENCH_MODE", "train")
     for var in ("BENCH_BATCH", "BENCH_PRESET", "BENCH_FAMILY",
-                "TS_PALLAS", "BENCH_PLATFORM", "BENCH_REMAT"):
+                "TS_PALLAS", "BENCH_PLATFORM", "BENCH_REMAT", "TS_FLASH"):
         monkeypatch.delenv(var, raising=False)
     base = bench._config_fingerprint()
     assert base == {"mode": "train", "platform": "tpu", "batch": 16,
                     "preset": "ref", "family": "pointer_generator",
-                    "pallas": "off", "unroll": 8, "remat": False}
+                    "pallas": "off", "flash": "off", "unroll": 8,
+                    "remat": False}
+    # pg never reads TS_FLASH: the RESOLVED axis must not split records
+    monkeypatch.setenv("TS_FLASH", "on")
+    assert bench._config_fingerprint() == base
+    # transformer: env forces the padded kernel -> different program
+    monkeypatch.setenv("BENCH_FAMILY", "transformer")
+    tf_on = bench._config_fingerprint()
+    assert tf_on["flash"] == "on"
+    monkeypatch.delenv("TS_FLASH")
+    # auto at ref scale (T=400, hd=32 unaligned) resolves to the einsum
+    # path — same program as off, so records cross-substitute correctly
+    assert bench._config_fingerprint()["flash"] == "off"
+    monkeypatch.delenv("BENCH_FAMILY")
     monkeypatch.setenv("BENCH_BATCH", "64")
     assert bench._config_fingerprint() != base
     # a CPU smoke record must never satisfy a TPU ask
@@ -294,7 +307,7 @@ def test_supervisor_emits_stale_record_when_tunnel_down(tmp_path):
 
     fp = {"mode": "train", "platform": "cpu", "batch": 16, "preset": "ref",
           "family": "pointer_generator", "remat": False, "pallas": "off",
-          "unroll": 8}
+          "flash": "off", "unroll": 8}
     path = tmp_path / "BENCH_ALL.jsonl"
     _write_jsonl(path, [
         {"metric": "train_samples_per_sec", "value": 552.8,
@@ -305,7 +318,7 @@ def test_supervisor_emits_stale_record_when_tunnel_down(tmp_path):
     # ambient sweep/config vars would shift the fingerprint away from
     # the hard-coded record above
     for var in ("TS_BENCH_CHILD", "BENCH_BATCH", "BENCH_PRESET",
-                "BENCH_FAMILY", "TS_PALLAS", "BENCH_REMAT"):
+                "BENCH_FAMILY", "TS_PALLAS", "BENCH_REMAT", "TS_FLASH"):
         env.pop(var, None)
     # a command that can never finish within the timeout stands in for a
     # hung tunnel; BENCH_SLEEP_FOR_TEST makes the child sleep before work

@@ -152,16 +152,18 @@ def test_bf16_forward_train_close_to_f32(setup):
 
 
 def test_flash_gating(monkeypatch):
-    """Flash self-attention only engages on lane-aligned long shapes AND
-    a TPU backend (the kernel has no CPU/GPU lowering); TS_FLASH=off
-    always wins; auto additionally requires T >= 1024."""
-    hps_small = tiny_hps()  # hd=4 -> never aligned
+    """Flash self-attention needs a TPU backend (the kernel has no
+    CPU/GPU lowering); TS_FLASH=off always wins; =on engages on ANY
+    shape (unaligned T/head_dim get zero-padded to the 128 grid); auto
+    — the frozen default — keeps the conservative natively-aligned
+    T >= 1024 rule."""
+    hps_small = tiny_hps()  # hd=4 -> auto never fires
     assert not tfm._use_flash(hps_small, 400)
     hps_big = tiny_hps(hidden_dim=1024, num_heads=8)  # hd=128
     monkeypatch.setenv("TS_FLASH", "on")
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert tfm._use_flash(hps_big, 1024)
-    assert not tfm._use_flash(hps_big, 400)  # T not lane-aligned
+    assert tfm._use_flash(hps_big, 400)  # forced: padded path handles it
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert not tfm._use_flash(hps_big, 1024)  # forced, but no TPU
     monkeypatch.setenv("TS_FLASH", "off")
@@ -209,6 +211,50 @@ def test_flash_branch_matches_einsum_interpret(monkeypatch):
                                rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(np.asarray(got_causal), np.asarray(ref_causal),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_flash_padded_unaligned_matches_einsum_interpret(monkeypatch):
+    """TS_FLASH=on at UNALIGNED shapes (reference-class T=40, hd=32)
+    zero-pads q/k/v to the 128 grid — fwd AND grad must match the
+    einsum path exactly on real rows, both encoder (padding mask) and
+    causal decoder.  This is the correctness gate under the
+    train_transformer_flash sweep row (BASELINE.md roofline: the einsum
+    path's materialized score tensors dominate the transformer step's
+    bytes)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    hps = tiny_hps(hidden_dim=128, num_heads=4)  # hd=32: not lane-aligned
+    T, B, H = 40, 2, 128
+    rng = np.random.RandomState(0)
+    p = {k: jnp.asarray(rng.randn(H, H) * 0.05, jnp.float32)
+         for k in ("wq", "wk", "wv", "wo")}
+    x = jnp.asarray(rng.randn(B, T, H) * 0.3, jnp.float32)
+    lens = np.array([T, T - 13])
+    mask = jnp.asarray((np.arange(T)[None] < lens[:, None]), jnp.float32)
+
+    def f_enc(x):
+        out = tfm._self_attention(hps, p, x, mask, causal=False)
+        return jnp.sum((out * mask[:, :, None]) ** 2)  # mask garbage rows
+
+    def f_dec(x):
+        return jnp.sum(tfm._self_attention(hps, p, x, None, causal=True)
+                       ** 2)
+
+    monkeypatch.setenv("TS_FLASH", "off")
+    refs = [f(x) for f in (f_enc, f_dec)]
+    grefs = [jax.grad(f)(x) for f in (f_enc, f_dec)]
+    monkeypatch.setenv("TS_FLASH", "on")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert tfm._use_flash(hps, T)
+    with pltpu.force_tpu_interpret_mode():
+        gots = [f(x) for f in (f_enc, f_dec)]
+        ggots = [jax.grad(f)(x) for f in (f_enc, f_dec)]
+    for ref, got in zip(refs, gots):
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    for gref, gflash in zip(grefs, ggots):
+        err = float(jnp.max(jnp.abs(gref - gflash)))
+        scale = float(jnp.max(jnp.abs(gref)))
+        assert err < 1e-5 * max(scale, 1.0), (err, scale)
 
 
 @pytest.mark.slow

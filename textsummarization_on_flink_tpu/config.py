@@ -260,3 +260,17 @@ def beam_chunk_from_env() -> int:
     import os
 
     return int(os.environ.get("TS_BEAM_CHUNK", "25"))
+
+
+def flash_mode_from_env() -> str:
+    """TS_FLASH resolved to 'on' / 'off' / 'auto' — the ONE token parser
+    (models/transformer._use_flash routes on it; bench.py's fingerprint
+    resolves it further to the actual kernel choice)."""
+    import os
+
+    v = os.environ.get("TS_FLASH", "auto").lower()
+    if v in ("1", "on", "true", "yes"):
+        return "on"
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    return "auto"

@@ -188,15 +188,21 @@ def _use_flash(hps: HParams, T: int) -> bool:
     """Route self-attention through the Pallas TPU flash kernel when it
     pays off: long sequences at head widths the kernel tiles natively
     (the [B, nh, T, T] score tensor never hits HBM).  TS_FLASH=on forces
-    it on eligible shapes, =off disables; auto additionally requires
-    T>=1024.  Either way the kernel is TPU-only (its Mosaic lowering has
-    no CPU/GPU path), so a non-TPU backend always falls through to the
-    einsum formula.  Cross-attention never uses it — its probabilities
-    ARE the copy distribution and must be materialized anyway."""
-    import os
+    it on ANY shape — unaligned T/head_dim are zero-padded to the 128
+    grid by the caller (exact numerics; extra FLOPs), which is the
+    roofline-motivated A/B for the bandwidth-bound reference scale
+    (T=400, hd=32 — BASELINE.md: the einsum path's materialized f32
+    score tensors dominate the transformer step's bytes).  =off
+    disables; auto (the FROZEN default) keeps the conservative
+    natively-aligned T>=1024 rule.  Either way the kernel is TPU-only
+    (its Mosaic lowering has no CPU/GPU path), so a non-TPU backend
+    always falls through to the einsum formula.  Cross-attention never
+    uses it — its probabilities ARE the copy distribution and must be
+    materialized anyway."""
+    from textsummarization_on_flink_tpu.config import flash_mode_from_env
 
-    env = os.environ.get("TS_FLASH", "auto").lower()
-    if env in ("0", "off", "false"):
+    mode = flash_mode_from_env()
+    if mode == "off":
         return False
     hd = _head_dim(hps)
     aligned = T % 128 == 0 and hd % 128 == 0
@@ -204,8 +210,8 @@ def _use_flash(hps: HParams, T: int) -> bool:
         on_tpu = jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover
         on_tpu = False
-    if env in ("1", "on", "true"):
-        return aligned and on_tpu
+    if mode == "on":
+        return on_tpu
     return on_tpu and aligned and T >= 1024
 
 
@@ -250,15 +256,34 @@ def _self_attention(hps: HParams, p: Dict[str, Array], x_norm: Array,
         from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
         q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # [B,nh,T,hd]
+        hd = q.shape[-1]
+        t_pad, hd_pad = -T % 128, -hd % 128
+        if t_pad or hd_pad:
+            # zero-pad to the kernel's 128-lane grid (TS_FLASH=on at
+            # unaligned shapes, e.g. reference scale T=400 hd=32).
+            # Exact numerics: zero head-dim columns change no dot
+            # product and their output columns are sliced away; zero
+            # key rows are excluded from real queries by the padding
+            # segment (non-causal) or live strictly in the future
+            # (causal); padded-tail query rows are sliced away.
+            widths = [(0, 0), (0, 0), (0, t_pad), (0, hd_pad)]
+            q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
         seg = None
-        if pad_mask is not None and not causal:
-            # padding keys live in a different segment than real tokens,
-            # so real queries never attend them (padding queries produce
-            # garbage rows that downstream masks discard)
-            ids = (pad_mask <= 0).astype(jnp.int32)  # [B, T]
+        if not causal:
+            # padding keys (article padding AND the alignment tail) live
+            # in a different segment than real tokens, so real queries
+            # never attend them (padding queries produce garbage rows
+            # that downstream masks discard)
+            pm = pad_mask if pad_mask is not None \
+                else jnp.ones((q.shape[0], T), q.dtype)
+            if t_pad:
+                pm = jnp.pad(pm, [(0, 0), (0, t_pad)])
+            ids = (pm <= 0).astype(jnp.int32)  # [B, T+t_pad]
             seg = fa.SegmentIds(q=ids, kv=ids)
         out = fa.flash_attention(q, k, v, segment_ids=seg, causal=causal,
                                  sm_scale=sm_scale)
+        if t_pad or hd_pad:
+            out = out[:, :, :T, :hd]
         ctx = _merge_heads(jnp.swapaxes(out, 1, 2))
         return ctx @ p["wo"].astype(ctx.dtype)
     if causal:
