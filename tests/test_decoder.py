@@ -204,3 +204,32 @@ def test_decoder_multichip_dp(tmp_path, vocab, train_dir):
     assert len(rows) == 4
     for uuid, art, summary, ref in rows:
         assert isinstance(summary, str)
+
+
+def test_attnvis_viewer_covers_written_fields(tmp_path):
+    """tools/attn_vis.html must reference every field write_for_attnvis
+    actually emits (decode.py:225-249 layout) — the expected list is
+    derived by CALLING the writer, so a rename on the python side fails
+    this test instead of silently breaking the in-repo visualizer."""
+    import numpy as np
+
+    class _Host:  # the two attributes write_for_attnvis reads
+        _decode_dir = str(tmp_path)
+        _hps = HPS
+
+    res = dec_lib.DecodedResult(
+        "u1", "the quick <fox>", ["quick", "."], "ref", ["a ref ."],
+        attn_dists=np.full((2, 3), 1 / 3), p_gens=np.array([0.25, 0.75]))
+    dec_lib.BeamSearchDecoder.write_for_attnvis(_Host(), res)
+    with open(tmp_path / "attn_vis_data.json") as f:
+        emitted = json.load(f)
+    assert "p_gens" in emitted  # pointer_gen on in HPS
+    html = open(os.path.join(os.path.dirname(__file__), "..", "tools",
+                             "attn_vis.html"), encoding="utf-8").read()
+    for field in emitted:
+        assert field in html, f"viewer never references {field!r}"
+    # the writer html-escapes tokens (make_html_safe); the viewer must
+    # unescape before textContent rendering or '<fox>' shows as
+    # '&lt;fox&gt;'
+    assert emitted["article_lst"][2] == "&lt;fox&gt;"
+    assert "unescape" in html
