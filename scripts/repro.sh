@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# One-command, no-hardware validation of the whole framework:
+#   scripts/repro.sh        # fast tier (~12 min): suite + dryrun + smokes
+#   scripts/repro.sh full   # adds the slow test tier (~25 min total)
+#
+# Uses the virtual 8-device CPU mesh throughout; scrubs the TPU plugin
+# off PYTHONPATH so a down tunnel can never hang an import (the axon
+# registration hook wedges `import jax` otherwise).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export PYTHONPATH="$PWD"
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+echo "== test suite"
+if [ "${1:-fast}" = "full" ]; then
+  python -m pytest tests/ -q
+else
+  python -m pytest tests/ -q -m "not slow"
+fi
+
+echo "== driver hooks: entry() trace + 8-device sharded dryrun"
+python -c "
+import jax, __graft_entry__ as g
+fn, args = g.entry()
+jax.jit(fn).lower(*args)
+print('entry() traces ok')
+g.dryrun_multichip(8)"
+
+echo "== bench smokes (CPU, tiny): train / input / decode"
+T="$(mktemp -d)"
+trap 'rm -rf "$T"' EXIT
+for mode in train input decode; do
+  BENCH_MODE="$mode" BENCH_PLATFORM=cpu BENCH_PRESET=tiny BENCH_STEPS=2 \
+    BENCH_SECONDS=0.5 BENCH_ATTEMPTS=1 BENCH_STALE_FILE="$T/all.jsonl" \
+    python bench.py 2>/dev/null | tail -1
+done
+
+echo "== roofline (XLA cost-model floors, tiny config)"
+python scripts/roofline.py --configs train_tiny --bench "$T/all.jsonl"
+
+echo "repro OK"

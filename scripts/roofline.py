@@ -102,7 +102,6 @@ def _load_bench():
 
 def cost_of_train_step(hps):
     """Compile the real train step and return XLA's {flops, bytes}."""
-    import jax
     import numpy as np
 
     from textsummarization_on_flink_tpu.train import trainer as trainer_lib
@@ -111,12 +110,7 @@ def cost_of_train_step(hps):
     state = trainer_lib.init_train_state(hps, hps.vocab_size, seed=0)
     step = trainer_lib.make_train_step(hps)
     arrays = _example_arrays(hps, np.random.RandomState(0))
-    compiled = jax.jit(step).lower(state, arrays).compile()
-    ca = compiled.cost_analysis()
-    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
-        ca = ca[0]
-    return {"flops": float(ca.get("flops", 0.0)),
-            "bytes": float(ca.get("bytes accessed", 0.0))}
+    return _cost_of(step, state, arrays)
 
 
 def analyze(tag: str, chip: str, bench_mod, measured: dict | None):
@@ -154,6 +148,58 @@ def analyze(tag: str, chip: str, bench_mod, measured: dict | None):
     return rec
 
 
+def _cost_of(fn, *args):
+    import jax
+
+    ca = jax.jit(fn).lower(*args).compile().cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+        ca = ca[0]
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes": float(ca.get("bytes accessed", 0.0))}
+
+
+def attribution_of(hps, full_step_cost=None):
+    """Where the step's bytes go, by phase: compile forward-only and
+    forward+backward, and diff against the full optimizer step —
+    backward = grad − forward, optimizer = step − grad.  Pass the
+    already-compiled full-step cost (analyze() has it) to avoid
+    recompiling the most expensive program.  (Phase diffs are the
+    model-agnostic seam; an encoder/decoder split would need per-family
+    surgery.)"""
+    import numpy as np
+
+    import jax
+
+    from textsummarization_on_flink_tpu.models import get_family
+    from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+    from __graft_entry__ import _example_arrays
+
+    family = get_family(hps.model_family)
+    state = trainer_lib.init_train_state(hps, hps.vocab_size, seed=0)
+    arrays = _example_arrays(hps, np.random.RandomState(0))
+
+    def fwd(params, arrays):
+        out = family.forward_train(params, hps, arrays)
+        return out.total_loss if hps.coverage else out.loss
+
+    if full_step_cost is None:
+        step = trainer_lib.make_train_step(hps)
+        full_step_cost = _cost_of(step, state, arrays)
+    phases = {
+        "forward": _cost_of(fwd, state.params, arrays),
+        "fwd+bwd": _cost_of(lambda p, a: jax.grad(fwd)(p, a),
+                            state.params, arrays),
+        "full step": dict(full_step_cost),
+    }
+    phases["backward (diff)"] = {
+        k: phases["fwd+bwd"][k] - phases["forward"][k]
+        for k in ("flops", "bytes")}
+    phases["optimizer (diff)"] = {
+        k: phases["full step"][k] - phases["fwd+bwd"][k]
+        for k in ("flops", "bytes")}
+    return phases
+
+
 def measured_rows(path: str) -> dict:
     """Newest live measurement per run tag (bench_latest's definition)."""
     if not os.path.exists(path):
@@ -172,6 +218,10 @@ def main(argv=None):
     ap.add_argument("--chip", default="v5e", choices=sorted(CHIPS))
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--bench", default=os.path.join(REPO, "BENCH_ALL.jsonl"))
+    ap.add_argument("--attribute", action="store_true",
+                    help="also compile forward and fwd+bwd per config "
+                         "(full-step cost is reused) and report the "
+                         "per-phase flop/byte split")
     args = ap.parse_args(argv)
 
     bench_mod = _load_bench()
@@ -183,7 +233,13 @@ def main(argv=None):
             raise SystemExit(f"unknown config {tag!r}; "
                              f"choose from {sorted(CONFIGS)}")
         print(f"[roofline] compiling {tag} ...", file=sys.stderr)
-        out.append(analyze(tag, args.chip, bench_mod, measured.get(tag)))
+        rec = analyze(tag, args.chip, bench_mod, measured.get(tag))
+        if args.attribute:
+            rec["attribution"] = attribution_of(
+                hps_for(tag, bench_mod),
+                full_step_cost={"flops": rec["xla_flops"],
+                                "bytes": rec["bytes_accessed"]})
+        out.append(rec)
     if args.json:
         for rec in out:
             print(json.dumps(rec))
@@ -202,6 +258,12 @@ def main(argv=None):
               f"{r['bytes_accessed'] / 1e9:>7.2f} "
               f"{r['min_step_ms']:>8.2f} "
               f"{r['max_samples_per_sec']:>9.0f} {meas:>9}")
+    for r in out:
+        if "attribution" in r:
+            print(f"\n{r['config']} phase split (GB accessed / GFLOP):")
+            for phase, c in r["attribution"].items():
+                print(f"  {phase:<17} {c['bytes'] / 1e9:>7.2f} GB  "
+                      f"{c['flops'] / 1e9:>8.1f} GFLOP")
     return 0
 
 

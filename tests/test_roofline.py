@@ -62,3 +62,20 @@ def test_cli_json_smoke(capsys):
            capsys.readouterr().out.strip().splitlines()]
     assert out and out[0]["config"] == "train_tiny"
     assert "measured_step_ms" not in out[0]
+
+
+@pytest.mark.slow
+def test_attribution_phases_consistent():
+    """Phase attribution: forward ⊆ fwd+bwd ⊆ full step in both flops
+    and bytes, and the diffs are what the table reports."""
+    bench_mod = roofline._load_bench()
+    hps = roofline.hps_for("train_tiny", bench_mod)
+    att = roofline.attribution_of(hps)
+    for k in ("flops", "bytes"):
+        assert att["forward"][k] > 0
+        assert att["fwd+bwd"][k] >= att["forward"][k]
+        assert att["full step"][k] >= att["fwd+bwd"][k]
+        assert att["backward (diff)"][k] == (att["fwd+bwd"][k]
+                                             - att["forward"][k])
+        assert att["optimizer (diff)"][k] == (att["full step"][k]
+                                              - att["fwd+bwd"][k])
