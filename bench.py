@@ -96,11 +96,15 @@ _METRIC_BY_MODE = {
 # --------------------------------------------------------------------------
 
 def _child_env() -> dict:
-    from __graft_entry__ import strip_tpu_plugin_paths
+    from __graft_entry__ import (
+        set_default_compile_cache,
+        strip_tpu_plugin_paths,
+    )
 
     env = dict(os.environ)
     env["TS_BENCH_CHILD"] = "1"
     repo_root = os.path.dirname(os.path.abspath(__file__))
+    set_default_compile_cache(env)
     if env.get("BENCH_MODE") == "input":
         # host-only mode: never let a down TPU tunnel hang the child
         env["BENCH_PLATFORM"] = "cpu"
