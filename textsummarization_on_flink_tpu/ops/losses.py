@@ -22,7 +22,6 @@ turns a [B, 50k+] scatter into an HBM-friendly reduction.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

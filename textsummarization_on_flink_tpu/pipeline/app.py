@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import logging
 import shlex
-from typing import List, Optional
+from typing import Optional
 
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.data.vocab import Vocab

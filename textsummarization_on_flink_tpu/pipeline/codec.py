@@ -17,7 +17,7 @@ multi-valued float list; unsupported types raise.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
 from textsummarization_on_flink_tpu.data.tfexample import Example
 from textsummarization_on_flink_tpu.pipeline.io import DataTypes, Row, RowSchema

@@ -25,7 +25,7 @@ import logging
 import queue
 import socket as socket_lib
 import threading
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 
 log = logging.getLogger(__name__)
 
