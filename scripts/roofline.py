@@ -163,9 +163,14 @@ def attribution_of(hps, full_step_cost=None):
     forward+backward, and diff against the full optimizer step —
     backward = grad − forward, optimizer = step − grad.  Pass the
     already-compiled full-step cost (analyze() has it) to avoid
-    recompiling the most expensive program.  (Phase diffs are the
-    model-agnostic seam; an encoder/decoder split would need per-family
-    surgery.)"""
+    recompiling the most expensive program.
+
+    Caveat on every (diff) row's BYTES: each phase is an independently
+    compiled program, and a standalone subprogram must materialize
+    outputs the bigger program may fuse away — so a diff can come out
+    low or even negative when fusion overlaps phases.  Flop diffs don't
+    have this problem (flop counts are fusion-independent).  The table
+    marks negative byte diffs as fusion overlap."""
     import numpy as np
 
     import jax
@@ -190,6 +195,21 @@ def attribution_of(hps, full_step_cost=None):
                             state.params, arrays),
         "full step": dict(full_step_cost),
     }
+    if hps.model_family == "pointer_generator":
+        # the pg family has a clean encoder seam (models.pointer_generator
+        # .encode); the remainder of forward is the decoder scan + the
+        # vocab projection + loss
+        from textsummarization_on_flink_tpu.models import (
+            pointer_generator as pg,
+        )
+
+        enc = _cost_of(
+            lambda p, a: pg.encode(p, hps, a["enc_batch"], a["enc_lens"],
+                                   a["enc_padding_mask"]),
+            state.params, arrays)
+        phases["encoder fwd"] = enc
+        phases["dec+loss fwd (diff)"] = {
+            k: phases["forward"][k] - enc[k] for k in ("flops", "bytes")}
     phases["backward (diff)"] = {
         k: phases["fwd+bwd"][k] - phases["forward"][k]
         for k in ("flops", "bytes")}
@@ -261,8 +281,10 @@ def main(argv=None):
         if "attribution" in r:
             print(f"\n{r['config']} phase split (GB accessed / GFLOP):")
             for phase, c in r["attribution"].items():
+                note = ("  [negative: fusion overlap between standalone-"
+                        "compiled phases]" if c["bytes"] < 0 else "")
                 print(f"  {phase:<17} {c['bytes'] / 1e9:>7.2f} GB  "
-                      f"{c['flops'] / 1e9:>8.1f} GFLOP")
+                      f"{c['flops'] / 1e9:>8.1f} GFLOP{note}")
     return 0
 
 

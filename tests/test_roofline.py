@@ -86,3 +86,12 @@ def test_attribution_phases_consistent():
     assert att["fwd+bwd"]["flops"] >= att["forward"]["flops"]
     assert att["full step"]["flops"] >= att["fwd+bwd"]["flops"]
     assert att["fwd+bwd"]["bytes"] >= 0.5 * att["forward"]["bytes"]
+    # pg family: the encoder seam splits forward
+    assert att["encoder fwd"]["flops"] > 0
+    assert att["forward"]["flops"] >= att["encoder fwd"]["flops"]
+    assert att["dec+loss fwd (diff)"]["flops"] == (
+        att["forward"]["flops"] - att["encoder fwd"]["flops"])
+    # bytes diffs may undershoot when fusion overlaps the standalone
+    # phases (docstring); bound loosely rather than exactly
+    assert att["dec+loss fwd (diff)"]["bytes"] >= \
+        -0.25 * att["forward"]["bytes"]
