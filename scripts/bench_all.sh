@@ -41,13 +41,19 @@ run() {
   # never lose a banked number.  The sweep only appends error/stale
   # stubs, which the watcher's completeness check keys off.
   line="$(env BENCH_RUN_TAG="$tag" "$@" python bench.py 2>/dev/null | tail -1)"
+  # helper invocations scrub PYTHONPATH: the axon sitecustomize hook
+  # costs ~1.8s per interpreter start (and can wedge when the tunnel is
+  # down).  `python bench.py` and the tunnel probe below KEEP the
+  # inherited path — the bench child needs the plugin to reach the TPU,
+  # and the probe must see the real backend or it would silently pass
+  # on CPU and the dead-tunnel early-abort would never fire
   if [ -z "$line" ]; then
     echo "{\"run\": \"$tag\", \"error\": \"no output\"}" >> "$OUT"
-  elif printf '%s\n' "$line" | python -c "
+  elif printf '%s\n' "$line" | env PYTHONPATH= python -c "
 import json,sys
 rec = json.loads(sys.stdin.read())
 sys.exit(0 if ('error' in rec or rec.get('stale')) else 1)" 2>/dev/null; then
-    printf '%s\n' "$line" | python -c "
+    printf '%s\n' "$line" | env PYTHONPATH= python -c "
 import json,sys
 rec = json.loads(sys.stdin.read()); rec['run'] = '$tag'
 print(json.dumps(rec))" >> "$OUT"

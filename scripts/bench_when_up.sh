@@ -31,8 +31,10 @@ while true; do
     # (append-only file: reruns overwrite by recency, newest wins).
     # one definition of "newest record per tag": bench_latest.py
     # (max captured_at, live beats stale on ties) — so a live row banked
-    # earlier in this window counts even if a later re-run timed out
-    if python - <<'PYEOF'
+    # earlier in this window counts even if a later re-run timed out.
+    # Scrubbed PYTHONPATH: the check needs no TPU plugin, and the axon
+    # sitecustomize hook is slow/wedge-prone when the tunnel is down.
+    if env PYTHONPATH= python - <<'PYEOF'
 import re
 import sys
 sys.path.insert(0, "scripts")
