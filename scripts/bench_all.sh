@@ -87,6 +87,7 @@ run train_transformer_flash BENCH_MODE=train BENCH_FAMILY=transformer TS_FLASH=o
 run trainer_e2e          BENCH_MODE=trainer
 run trainer_e2e_spd1     BENCH_MODE=trainer BENCH_SPD=1
 run decode_b4            BENCH_MODE=decode
+run decode_b1            BENCH_MODE=decode BENCH_BATCH=1
 run decode_chunked       BENCH_MODE=decode TS_BEAM_LOOP=chunked
 run decode_while         BENCH_MODE=decode TS_BEAM_LOOP=while
 run decode_transformer   BENCH_MODE=decode BENCH_FAMILY=transformer
