@@ -55,6 +55,17 @@ PYEOF
     then
       echo "[watch] sweep complete — all rows live"
       touch BENCH_SWEEP_DONE
+      # version the captured numbers immediately: an unattended success
+      # must survive even if nothing else touches the repo afterwards.
+      # Pathspec commit (-o): never sweep unrelated staged work into a
+      # bench-labelled commit; errors go to the log, not /dev/null.
+      if git commit -q -o BENCH_ALL.jsonl \
+          -m "Bench sweep: on-hardware numbers captured (watcher auto-commit)"
+      then
+        echo "[watch] BENCH_ALL.jsonl committed"
+      else
+        echo "[watch] auto-commit FAILED (rc=$?) — records remain in the working tree"
+      fi
       exit 0
     fi
     echo "[watch] sweep incomplete; will retry"
