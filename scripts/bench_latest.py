@@ -4,10 +4,12 @@
 The sweep file is append-only (scripts/bench_all.sh) so one sweep row
 can appear many times across reruns; BASELINE.md wants the latest view.
 
-    python scripts/bench_latest.py [BENCH_ALL.jsonl] [--json]
+    python scripts/bench_latest.py [BENCH_ALL.jsonl] [--json|--md]
 
 Default output is a small aligned table; --json emits one JSON line per
-tag (newest record verbatim) for machine use.
+tag (newest record verbatim) for machine use; --md emits the markdown
+measured table BASELINE.md embeds (so a fresh sweep is publishable by
+paste).
 """
 
 import json
@@ -43,6 +45,29 @@ def _recency(rec):
     return (str(rec.get("captured_at", "")), 0 if rec.get("stale") else 1)
 
 
+def _md_table(latest):
+    """Markdown rows (newest per tag) in sweep-file order."""
+    lines = ["| Sweep row | Value | Detail | Captured | Status |",
+             "|---|---|---|---|---|"]
+    for tag, rec in latest.items():
+        if "error" in rec:
+            lines.append(f"| `{tag}` | — | {rec['error'][:60]} | — | error |")
+            continue
+        value = f"**{rec.get('value')}** {rec.get('unit', '')}".strip()
+        extras = []
+        for key, label in (("step_time_ms", "step"), ("mfu", "MFU"),
+                           ("p99_ms", "p99"), ("tokens_per_sec", "tok/s"),
+                           ("vs_baseline", "vs K40m")):
+            if rec.get(key) is not None:
+                suffix = " ms" if key in ("step_time_ms", "p99_ms") else ""
+                extras.append(f"{label} {rec[key]}{suffix}")
+        captured = (rec.get("captured_at") or "?").replace("T", " ")[:16]
+        status = "stale" if rec.get("stale") else "live"
+        lines.append(f"| `{tag}` | {value} | {', '.join(extras) or '—'} "
+                     f"| {captured} | {status} |")
+    return "\n".join(lines)
+
+
 def main(argv):
     args = [a for a in argv if not a.startswith("--")]
     path = args[0] if args else "BENCH_ALL.jsonl"
@@ -50,6 +75,9 @@ def main(argv):
     if "--json" in argv:
         for tag in latest:
             print(json.dumps(latest[tag]))
+        return 0
+    if "--md" in argv:
+        print(_md_table(latest))
         return 0
     width = max((len(t) for t in latest), default=3)
     for tag, rec in latest.items():

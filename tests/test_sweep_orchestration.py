@@ -82,6 +82,82 @@ def test_sweep_writes_every_row_once_and_completeness_passes(tmp_path):
     assert set(tags) <= live
 
 
+def test_sweep_skips_already_live_rows_incrementally(tmp_path):
+    """Tunnel windows can be ~2 min; each pass must bank NEW rows, not
+    re-measure banked ones.  A pre-seeded live train_b16 is skipped
+    (exactly one record for its tag after the pass), stale/error seeds
+    are re-run, and BENCH_FORCE=1 re-measures everything."""
+    repo = _scratch_repo(tmp_path)
+    seed = [
+        {"metric": "stub_train", "value": 9.0, "unit": "x",
+         "vs_baseline": 1.0, "captured_at": "2026-07-31T00:00:00Z",
+         "run": "train_b16"},
+        {"metric": "stub_train", "value": 0.0, "unit": "x",
+         "vs_baseline": 0.0, "captured_at": "2026-07-31T00:00:01Z",
+         "stale": True, "run": "train_b64"},
+        {"run": "decode_b4", "error": "boom"},
+    ]
+    (repo / "BENCH_ALL.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in seed))
+    proc = subprocess.run(["bash", "scripts/bench_all.sh"], cwd=repo,
+                          env=_run_env(),
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    lines = [json.loads(s) for s in
+             (repo / "BENCH_ALL.jsonl").read_text().strip().splitlines()]
+    per_tag = {}
+    for rec in lines:
+        per_tag.setdefault(rec.get("run"), []).append(rec)
+    # live seed skipped: still exactly the one seeded record, value 9.0
+    assert len(per_tag["train_b16"]) == 1
+    assert per_tag["train_b16"][0]["value"] == 9.0
+    assert "skipped" in proc.stderr
+    # stale and error seeds re-measured live
+    assert any(not r.get("stale") for r in per_tag["train_b64"])
+    assert any("error" not in r for r in per_tag["decode_b4"])
+    # BENCH_FORCE re-measures the live row too
+    env = _run_env()
+    env["BENCH_FORCE"] = "1"
+    proc = subprocess.run(["bash", "scripts/bench_all.sh"], cwd=repo,
+                          env=env, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    lines = [json.loads(s) for s in
+             (repo / "BENCH_ALL.jsonl").read_text().strip().splitlines()]
+    fresh = [r for r in lines
+             if r.get("run") == "train_b16" and r["value"] == 1.0]
+    assert fresh, "BENCH_FORCE=1 did not re-measure the live row"
+
+
+def test_bench_latest_md_table(tmp_path):
+    """--md renders the newest-per-tag view as the markdown table
+    BASELINE.md embeds (errors and staleness visible, newest wins)."""
+    path = tmp_path / "b.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in [
+        {"metric": "m", "value": 1.0, "unit": "samples/s", "run": "a",
+         "captured_at": "2026-07-31T00:00:00Z"},
+        {"metric": "m", "value": 2.0, "unit": "samples/s", "run": "a",
+         "captured_at": "2026-07-31T01:00:00Z", "step_time_ms": 13.4},
+        {"run": "b", "error": "tunnel down"},
+        {"metric": "m", "value": 3.0, "unit": "ms", "run": "c",
+         "captured_at": "2026-07-31T00:30:00Z", "stale": True},
+    ]))
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import importlib
+
+        import bench_latest
+
+        importlib.reload(bench_latest)
+        out = bench_latest._md_table(bench_latest.latest_by_tag(str(path)))
+    finally:
+        sys.path.pop(0)
+    assert "**2.0** samples/s" in out and "**1.0**" not in out
+    assert "step 13.4 ms" in out
+    assert "| error |" in out and "tunnel down" in out
+    assert "| stale |" in out
+
+
 def test_sweep_appends_error_stub_so_watcher_retries(tmp_path):
     """A failing row must leave a tagged error stub (the watcher's signal
     to retry the pass), and must not abort the remaining rows unless the
