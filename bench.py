@@ -610,6 +610,7 @@ def bench_decode() -> None:
     lat_raw = []
     tokens = 0
     t_total = 0.0
+    all_lengths = []
     for _ in range(iters):
         t0 = time.perf_counter()
         out = beam_search.run_beam_search_jit(params, hps, arrays,
@@ -622,6 +623,7 @@ def bench_decode() -> None:
         t_total += dt
         # length includes START (beam_search.py:57-58); generated = len-1
         tokens += int(np.sum(lengths - 1))
+        all_lengths.extend(int(x) for x in lengths)
 
     def pct(xs, q):
         xs = sorted(xs)
@@ -653,6 +655,13 @@ def bench_decode() -> None:
         "batch": batch,
         "beam_loop": beam_loop,
         "tunnel_rtt_ms": round(rtt * 1e3, 2),
+        # generated steps of each best hypothesis (length-1): the proxy
+        # for how much of max_dec_steps early-exit loops (while/chunked)
+        # can save vs scan's fixed iteration count — the data the
+        # TS_BEAM_LOOP auto-choice decision needs (PERF.md decode rows)
+        "gen_steps_p50": int(np.median(all_lengths)) - 1,
+        "gen_steps_max": max(all_lengths) - 1,
+        "max_dec_steps": hps.max_dec_steps,
     }
     rec.update(info)
     print(json.dumps(rec))
