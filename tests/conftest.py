@@ -15,11 +15,27 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Share the repo-local persistent compile cache the bench/dryrun
+# children already use (__graft_entry__.set_default_compile_cache):
+# cache keys include the HLO + backend/compile options, so CPU test
+# programs can't collide with TPU bench entries, and repeat suite runs
+# skip recompiles.  The 0.5s floor catches this suite's many ~1s model
+# compiles that the 1s default would skip.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 # The env var alone is not always honored once the axon TPU plugin has
 # registered, so force the platform through jax.config as well.
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# the axon sitecustomize hook imports jax before this file runs, so the
+# env vars above can land too late — force the cache through jax.config
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 # Build the native library at test time (a fresh clone + toolchain must
