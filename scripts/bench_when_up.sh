@@ -14,7 +14,11 @@ unset BENCH_STALE_FILE
 rm -f BENCH_SWEEP_DONE
 while true; do
   echo "[watch] $(date -u +%H:%M:%S) probing tunnel..."
-  if timeout 75 python -c "import jax; print(jax.devices())" \
+  # 40s: a healthy tunnel answers in ~10s; the timeout only bounds the
+  # DOWN case, and a shorter one tightens the probe cycle (catching
+  # ~2-min windows).  bench_all.sh's mid-sweep abort probe stays at 75s
+  # — there a false DOWN verdict costs a whole pass.
+  if timeout 40 python -c "import jax; print(jax.devices())" \
       >/dev/null 2>&1; then
     echo "[watch] tunnel UP — banking the quick headline row first"
     # even a ~5-minute tunnel window must bank the headline train number
