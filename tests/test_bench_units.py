@@ -377,3 +377,37 @@ def test_preset_overrides_family(monkeypatch):
     monkeypatch.delenv("BENCH_FAMILY")
     o2 = bench._preset_overrides()
     assert "model_family" not in o2
+
+
+@pytest.mark.slow
+def test_decode_child_reports_step_usage(tmp_path):
+    """BENCH_MODE=decode end to end through the real supervisor+child on
+    CPU at tiny scale: the record carries the loop-decision data
+    (gen_steps_p50/max vs max_dec_steps — PERF.md's corrected chunked
+    rule reads these) and self-appends with a decode fingerprint."""
+    import json
+    import subprocess
+
+    path = tmp_path / "BENCH_ALL.jsonl"
+    env = dict(os.environ)
+    for var in ("TS_BENCH_CHILD", "BENCH_BATCH", "BENCH_PRESET",
+                "BENCH_FAMILY", "TS_PALLAS", "BENCH_NO_RECORD",
+                "TS_BEAM_LOOP"):
+        env.pop(var, None)
+    env.update(BENCH_MODE="decode", BENCH_PRESET="tiny", BENCH_STEPS="2",
+               BENCH_BATCH="2", BENCH_ATTEMPTS="1", BENCH_TIMEOUT="240",
+               BENCH_PLATFORM="cpu", BENCH_STALE_FILE=str(path),
+               BENCH_RUN_TAG="decode_b4")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    # random params never emit STOP, so every hypothesis runs the full
+    # budget — exactly the caveat the fields exist to expose
+    assert rec["max_dec_steps"] >= rec["gen_steps_max"]
+    assert rec["gen_steps_max"] >= rec["gen_steps_p50"] >= 1
+    assert rec["config_fingerprint"]["mode"] == "decode"
+    lines = [json.loads(s) for s in path.read_text().strip().splitlines()]
+    assert len(lines) == 1 and lines[0] == rec
