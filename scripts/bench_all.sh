@@ -109,6 +109,7 @@ run decode_chunked       BENCH_MODE=decode TS_BEAM_LOOP=chunked
 run decode_while         BENCH_MODE=decode TS_BEAM_LOOP=while
 run decode_transformer   BENCH_MODE=decode BENCH_FAMILY=transformer
 run train_b16_unroll1    BENCH_MODE=train BENCH_UNROLL=1
+run train_b16_unroll16   BENCH_MODE=train BENCH_UNROLL=16
 run train_b16_pallas     BENCH_MODE=train TS_PALLAS=on
 run train_b16_remat      BENCH_MODE=train BENCH_REMAT=1
 run train_scaled         BENCH_MODE=train BENCH_PRESET=scaled
