@@ -15,6 +15,14 @@ SAME script on every host of a TPU pod slice:
 The hps mesh axes (dp/tp/sp) span the GLOBAL device set: with 4 hosts x 8
 chips, --dp=32 data-shards the batch over every chip and XLA all-reduces
 gradients over ICI/DCN. Only the chief (process 0) writes checkpoints.
+
+Smoke-test on CPU (single process, virtual 8-chip mesh, dp=8):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/multihost_train.py --smoke
+
+(The REAL 2-process rendezvous path is exercised by
+tests/test_multiprocess.py.)
 """
 
 import os
@@ -28,7 +36,46 @@ from textsummarization_on_flink_tpu.data.vocab import Vocab  # noqa: E402
 from textsummarization_on_flink_tpu.parallel import distributed  # noqa: E402
 
 
+SMOKE = [
+    "--hidden_dim=16", "--emb_dim=16", "--max_enc_steps=16",
+    "--max_dec_steps=8", "--vocab_size=64", "--max_oov_buckets=8",
+    "--batch_size=8", "--beam_size=2", "--min_dec_steps=1", "--dp=8",
+    "--num_steps=2", "--checkpoint_steps=0",
+]
+
+
 def main(argv):
+    if "--smoke" in argv:
+        import tempfile
+
+        import numpy as np
+
+        from textsummarization_on_flink_tpu.data.batcher import Batcher
+        from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+
+        distributed.initialize()  # single process: no-op rendezvous
+        hps = HParams.from_argv(SMOKE).replace(mode="train")
+        hps.validate()
+        words = [f"w{i}" for i in range(60)]
+        vocab = Vocab(words=words, max_size=hps.vocab_size)
+
+        def src():
+            rng = np.random.RandomState(0)
+            while True:
+                yield (" ".join(rng.choice(words[:40], 12)),
+                       "<s> " + " ".join(rng.choice(words[:40], 4))
+                       + " . </s>")
+
+        batcher = Batcher("", vocab, hps, single_pass=False,
+                          example_source=src)
+        tr = trainer_lib.Trainer(hps, vocab.size(), batcher,
+                                 train_dir=tempfile.mkdtemp())
+        state = tr.train(num_steps=hps.num_steps)
+        if distributed.is_chief():
+            print(f"multihost smoke ok: step={int(state.step)} "
+                  f"(dp={hps.dp} over {len(__import__('jax').devices())} "
+                  f"devices)")
+        return
     distributed.initialize(
         coordinator_address=os.environ.get("COORD"),
         num_processes=(int(os.environ["NPROC"])
