@@ -56,10 +56,13 @@ def _md_table(latest):
         value = f"**{rec.get('value')}** {rec.get('unit', '')}".strip()
         extras = []
         for key, label in (("step_time_ms", "step"), ("mfu", "MFU"),
-                           ("p99_ms", "p99"), ("tokens_per_sec", "tok/s"),
+                           ("p99_ms", "p99"),
+                           ("p50_rtt_corrected_ms", "p50 device"),
+                           ("tokens_per_sec", "tok/s"),
                            ("vs_baseline", "vs K40m")):
             if rec.get(key) is not None:
-                suffix = " ms" if key in ("step_time_ms", "p99_ms") else ""
+                suffix = (" ms" if key in ("step_time_ms", "p99_ms",
+                                           "p50_rtt_corrected_ms") else "")
                 extras.append(f"{label} {rec[key]}{suffix}")
         captured = (rec.get("captured_at") or "?").replace("T", " ")[:16]
         status = "stale" if rec.get("stale") else "live"
