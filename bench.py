@@ -190,6 +190,11 @@ def _config_fingerprint() -> dict:
         # per-step dynamic cost, C=T degenerates to scan)
         loop = (os.environ.get("TS_BEAM_LOOP", "auto") or "auto").lower()
         fp["beam_loop"] = loop
+        # decode params source (VERDICT r4 weak #1): a trained fixture
+        # and a STOP-biased init produce different generated-step counts,
+        # so their latencies must never cross-substitute — and neither
+        # may stand in for the old random-init worst case
+        fp["params"] = _decode_params_spec(fp["family"])
         if loop == "chunked":
             # same env resolution beam_search.resolved_chunk uses; lives
             # in config.py because this supervisor must not import
@@ -205,6 +210,51 @@ def _config_fingerprint() -> dict:
     elif mode == "input":
         fp["batch"] = int(os.environ.get("BENCH_BATCH", "16"))
     return fp
+
+
+def _decode_fixture_path(family: str) -> str:
+    """Trained decode fixture for BENCH_MODE=decode (generated by
+    exp/train_decode_fixture.py; deliberately untracked — the script is
+    the committed recipe).  BENCH_DECODE_FIXTURE overrides the path, or
+    disables the fixture entirely with ''/'0'/'none'."""
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    return os.environ.get(
+        "BENCH_DECODE_FIXTURE",
+        os.path.join(repo_root, "exp", f"decode_fixture_{family}.npz"))
+
+
+def _decode_params_spec(family: str) -> str:
+    """How BENCH_MODE=decode obtains STOP-capable params (VERDICT r4
+    weak #1: random init never emits STOP, so every beam ran all
+    max_dec_steps and the loop A/B could only measure overhead).
+    'fixture' when the trained fixture file exists, else
+    'stop_bias:<b>' — init params with BENCH_STOP_BIAS (default 6.0,
+    calibrated on CPU at reference scale: pg finishes at the
+    min_dec_steps floor of 36 generated steps, transformer spreads
+    36-100 with p50 45) added to the STOP logit of every vocab-sized
+    bias vector.  Dependency-light: callable from the supervisor's
+    fingerprint with the tunnel down."""
+    path = _decode_fixture_path(family)
+    # default-path auto-detection only applies at the reference preset:
+    # the fixture is trained at reference scale, so a tiny/scaled-preset
+    # run must not pick it up (shape-guard failure on every smoke run).
+    # An EXPLICIT BENCH_DECODE_FIXTURE is honored as asked — a mismatch
+    # fails loudly in _load_decode_fixture.
+    explicit = os.environ.get("BENCH_DECODE_FIXTURE") is not None
+    preset_ok = (explicit
+                 or (os.environ.get("BENCH_PRESET", "ref") or "ref") == "ref")
+    if preset_ok and path and path.lower() not in ("0", "none"):
+        if os.path.exists(path):
+            return "fixture"
+        if explicit:
+            # an explicitly requested fixture must never silently degrade
+            # to stop-bias params — the banked rows would masquerade as
+            # trained-fixture numbers
+            raise ValueError(
+                f"BENCH_DECODE_FIXTURE={path} does not exist "
+                f"(generate it: exp/train_decode_fixture.py, or set "
+                f"BENCH_DECODE_FIXTURE=none for STOP-biased init params)")
+    return "stop_bias:%g" % float(os.environ.get("BENCH_STOP_BIAS", "6.0"))
 
 
 def _records_path() -> str:
@@ -572,6 +622,56 @@ def bench_train() -> None:
     print(json.dumps(rec))
 
 
+def _stop_biased(params, vsize: int, bias: float):
+    """STOP-capable params from a random init: add `bias` to the STOP
+    logit of every vocab-sized bias vector (pg output_projection.v,
+    transformer out_bias).  Random-init logits are effectively
+    stationary per article, so an article either emits STOP as soon as
+    min_dec_steps allows or never — the calibrated default (see
+    _decode_params_spec) puts finishes in the realistic band instead of
+    the all-100-steps worst case."""
+    import jax
+
+    from textsummarization_on_flink_tpu.data.vocab import STOP_ID
+
+    def bump(x):
+        if getattr(x, "shape", None) == (vsize,):
+            return x.at[STOP_ID].add(bias)
+        return x
+
+    return jax.tree_util.tree_map(bump, params)
+
+
+def _load_decode_fixture(path: str, init):
+    """Load a trained decode fixture (npz of keystr->array, written by
+    exp/train_decode_fixture.py) into init_params' tree structure,
+    validated leaf-for-leaf so a stale or wrong-scale fixture fails
+    loudly instead of silently measuring a different model."""
+    import jax
+
+    data = np.load(path)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(init)
+    extra = set(data.files) - {jax.tree_util.keystr(k) for k, _ in flat}
+    if extra:
+        raise ValueError(
+            f"decode fixture {path} has keys the model does not: "
+            f"{sorted(extra)[:4]} — trained under a different config "
+            f"(e.g. coverage)? regenerate: exp/train_decode_fixture.py")
+    leaves = []
+    for key_path, leaf in flat:
+        key = jax.tree_util.keystr(key_path)
+        if key not in data:
+            raise ValueError(f"decode fixture {path} is missing {key!r} "
+                             f"(regenerate: exp/train_decode_fixture.py)")
+        arr = np.asarray(data[key])
+        if arr.shape != leaf.shape:
+            raise ValueError(
+                f"decode fixture {path} leaf {key!r} has shape {arr.shape}, "
+                f"model expects {leaf.shape} (wrong scale? regenerate)")
+        leaves.append(arr.astype(np.asarray(leaf).dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def bench_decode() -> None:
     """BENCH_MODE=decode: batched beam-search decode at the reference
     serving config (batch 4, enc 400, dec 100, beam 4,
@@ -595,6 +695,13 @@ def bench_decode() -> None:
         hps = hps.replace(coverage=False)
     family = get_family(hps.model_family)
     params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(0))
+    params_spec = _decode_params_spec(hps.model_family)
+    if params_spec == "fixture":
+        params = _load_decode_fixture(
+            _decode_fixture_path(hps.model_family), params)
+    else:
+        params = _stop_biased(params, hps.vocab_size,
+                              float(params_spec.split(":", 1)[1]))
     arrays = _example_arrays(hps, np.random.RandomState(0))
     arrays = {k: v for k, v in arrays.items()
               if not k.startswith(("dec_", "target_"))}
@@ -654,6 +761,7 @@ def bench_decode() -> None:
         "beam_size": hps.beam_size,
         "batch": batch,
         "beam_loop": beam_loop,
+        "params_source": params_spec,
         "tunnel_rtt_ms": round(rtt * 1e3, 2),
         # generated steps of each best hypothesis (length-1): the proxy
         # for how much of max_dec_steps early-exit loops (while/chunked)

@@ -33,25 +33,78 @@ unset BENCH_NO_RECORD
 export BENCH_ATTEMPTS="${BENCH_ATTEMPTS:-1}"
 # tunnel windows have been observed as short as ~2 min; a warm-cache row
 # measures in ~60-90s, so 360s covers a cold compile while capping the
-# time a mid-window tunnel drop can burn before the early-abort probe
+# time a mid-window tunnel drop can burn before the early-abort probe.
+# ADVICE r4 (medium): the cap is mode-aware — full-scale beam-search
+# while/chunked first compiles can exceed 360s (bench.py's own decode
+# default is 1200s), and a child killed mid-compile writes nothing to
+# the persistent compile cache, so a flat cap would time those rows out
+# identically on every pass; their run lines below pass a longer
+# per-row BENCH_TIMEOUT instead.
 export BENCH_TIMEOUT="${BENCH_TIMEOUT:-360}"
+
+# set by run() whenever a row banked a LIVE measurement; ratio sections
+# reset it to detect "this pass banked a new numerator here".
+# SKIPPED_TAGS collects the skipped-as-live rows so pair_denominator
+# only re-measures a denominator that was NOT already measured in this
+# same pass/window.
+DID_MEASURE=0
+SKIPPED_TAGS=""
+
+# pair_denominator TAG ENV...: A/B lever rows are ratioed against a
+# denominator row, and PERF.md's ±3%/1.05x kill rules assume both sides
+# of the ratio came from the SAME tunnel window (ADVICE r4: a banked
+# denominator may be days and a different tunnel/compile-cache state
+# older).  Call after a ratio section: if the section banked a new
+# numerator while its denominator was skipped-as-live, re-measure the
+# denominator once, in the same window.
+pair_denominator() {
+  local denom="$1"; shift
+  if [ "$DID_MEASURE" = 1 ]; then
+    case "$SKIPPED_TAGS" in *" $denom "*)
+      echo "[sweep] ratio row(s) banked but $denom was skipped-as-live — re-measuring the denominator in the same window" >&2
+      BENCH_FORCE=1 run "$denom" "$@"
+      ;;
+    esac
+  fi
+}
 
 run() {
   local tag="$1"; shift
   # incremental banking: rows whose NEWEST record is already a live
   # measurement are skipped, so each short tunnel window adds NEW rows
   # instead of re-measuring banked ones.  BENCH_FORCE=1 re-measures all.
-  if [ -z "${BENCH_FORCE:-}" ] && env PYTHONPATH= python - "$tag" "$OUT" <<'PYEOF' 2>/dev/null
+  # ADVICE r4: the record must also carry the fingerprint bench.py would
+  # compute for THIS row's env — after a perf-default flip (say the
+  # unroll default moves), a banked old-config record would otherwise be
+  # skipped forever and served as the current headline, the exact
+  # substitution bench.py's stale fallback refuses via fingerprint match.
+  if [ -z "${BENCH_FORCE:-}" ]; then
+    # exit 0 = live (skip), 1 = needs measuring, 2 = the check itself
+    # crashed — warn and fall through to measuring, so a broken check
+    # degrades to re-measuring WITH a diagnostic instead of silently
+    # disabling incremental banking (stderr kept for the same reason)
+    env PYTHONPATH= "$@" python - "$tag" "$OUT" <<'PYEOF'
 import sys
-sys.path.insert(0, "scripts")
-from bench_latest import latest_by_tag
-rec = latest_by_tag(sys.argv[2]).get(sys.argv[1])
-live = rec is not None and "error" not in rec and not rec.get("stale")
+try:
+    sys.path.insert(0, "scripts"); sys.path.insert(0, ".")
+    from bench_latest import latest_by_tag
+    import bench
+    rec = latest_by_tag(sys.argv[2]).get(sys.argv[1])
+    live = (rec is not None and "error" not in rec and not rec.get("stale")
+            and rec.get("config_fingerprint") == bench._config_fingerprint())
+except Exception as exc:  # noqa: BLE001
+    print(f"liveness check failed: {type(exc).__name__}: {exc}",
+          file=sys.stderr)
+    sys.exit(2)
 sys.exit(0 if live else 1)
 PYEOF
-  then
-    echo "== $tag (already live — skipped; BENCH_FORCE=1 re-measures)" >&2
-    return 0
+    case $? in
+      0)
+        echo "== $tag (already live — skipped; BENCH_FORCE=1 re-measures)" >&2
+        SKIPPED_TAGS="$SKIPPED_TAGS $tag "
+        return 0 ;;
+      2) echo "[sweep] liveness check crashed for '$tag' — re-measuring" >&2 ;;
+    esac
   fi
   echo "== $tag" >&2
   local line
@@ -76,12 +129,17 @@ sys.exit(0 if ('error' in rec or rec.get('stale')) else 1)" 2>/dev/null; then
 import json,sys
 rec = json.loads(sys.stdin.read()); rec['run'] = '$tag'
 print(json.dumps(rec))" >> "$OUT"
-  elif ! grep -qF "$line" "$OUT"; then
-    # bench.py appends successes itself, printing the identical JSON it
-    # recorded — if the line is missing, the self-append failed (its
-    # stderr warning was discarded above); do not lose the measurement
-    echo "[sweep] self-append missing for '$tag'; appending fallback" >&2
-    printf '%s\n' "$line" >> "$OUT"
+  else
+    # a LIVE measurement banked (only this arms the paired-denominator
+    # re-measure — an error/stale row pairs with nothing)
+    DID_MEASURE=1
+    if ! grep -qF "$line" "$OUT"; then
+      # bench.py appends successes itself, printing the identical JSON it
+      # recorded — if the line is missing, the self-append failed (its
+      # stderr warning was discarded above); do not lose the measurement
+      echo "[sweep] self-append missing for '$tag'; appending fallback" >&2
+      printf '%s\n' "$line" >> "$OUT"
+    fi
   fi
   # a timed-out row usually means the tunnel died mid-sweep; probe once
   # and abort the pass early if so (the watcher retries the whole pass —
@@ -103,15 +161,22 @@ run train_b16            BENCH_MODE=train
 run decode_b4            BENCH_MODE=decode
 run train_transformer    BENCH_MODE=train BENCH_FAMILY=transformer
 run trainer_e2e          BENCH_MODE=trainer
+# --- decode A/B lever rows, ratioed against decode_b4 (loop-strategy
+# choice + batch-amortization): same-window denominator pairing
+DID_MEASURE=0
 run decode_b1            BENCH_MODE=decode BENCH_BATCH=1
-run train_b64            BENCH_MODE=train BENCH_BATCH=64
-run decode_chunked       BENCH_MODE=decode TS_BEAM_LOOP=chunked
-run decode_while         BENCH_MODE=decode TS_BEAM_LOOP=while
+run decode_chunked       BENCH_MODE=decode TS_BEAM_LOOP=chunked BENCH_TIMEOUT=1200
+run decode_while         BENCH_MODE=decode TS_BEAM_LOOP=while BENCH_TIMEOUT=1200
+pair_denominator decode_b4 BENCH_MODE=decode
 run decode_transformer   BENCH_MODE=decode BENCH_FAMILY=transformer
+# --- train A/B lever rows, ratioed against train_b16
+DID_MEASURE=0
 run train_b16_unroll1    BENCH_MODE=train BENCH_UNROLL=1
 run train_b16_unroll16   BENCH_MODE=train BENCH_UNROLL=16
 run train_b16_pallas     BENCH_MODE=train TS_PALLAS=on
 run train_b16_remat      BENCH_MODE=train BENCH_REMAT=1
+run train_b64            BENCH_MODE=train BENCH_BATCH=64
+pair_denominator train_b16 BENCH_MODE=train
 run train_scaled         BENCH_MODE=train BENCH_PRESET=scaled
 run train_transformer_flash BENCH_MODE=train BENCH_FAMILY=transformer TS_FLASH=on
 run trainer_e2e_spd1     BENCH_MODE=trainer BENCH_SPD=1

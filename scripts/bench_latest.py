@@ -45,25 +45,36 @@ def _recency(rec):
     return (str(rec.get("captured_at", "")), 0 if rec.get("stale") else 1)
 
 
+def _md_cell(text):
+    """Raw record strings can hold '|' (plausible in error text) or
+    newlines, either of which breaks the table layout (ADVICE r4)."""
+    return " ".join(str(text).split()).replace("|", "\\|")
+
+
 def _md_table(latest):
     """Markdown rows (newest per tag) in sweep-file order."""
     lines = ["| Sweep row | Value | Detail | Captured | Status |",
              "|---|---|---|---|---|"]
     for tag, rec in latest.items():
         if "error" in rec:
-            lines.append(f"| `{tag}` | — | {rec['error'][:60]} | — | error |")
+            lines.append(f"| `{tag}` | — | {_md_cell(rec['error'][:60])} "
+                         f"| — | error |")
             continue
-        value = f"**{rec.get('value')}** {rec.get('unit', '')}".strip()
+        value = _md_cell(f"**{rec.get('value')}** {rec.get('unit', '')}")
         extras = []
         for key, label in (("step_time_ms", "step"), ("mfu", "MFU"),
                            ("p99_ms", "p99"),
                            ("p50_rtt_corrected_ms", "p50 device"),
                            ("tokens_per_sec", "tok/s"),
+                           ("gen_steps_p50", "gen steps p50"),
                            ("vs_baseline", "vs K40m")):
             if rec.get(key) is not None:
+                if key == "mfu":  # docs quote percent, not raw fraction
+                    extras.append(f"MFU {rec[key] * 100:.1f}%")
+                    continue
                 suffix = (" ms" if key in ("step_time_ms", "p99_ms",
                                            "p50_rtt_corrected_ms") else "")
-                extras.append(f"{label} {rec[key]}{suffix}")
+                extras.append(f"{label} {_md_cell(rec[key])}{suffix}")
         captured = (rec.get("captured_at") or "?").replace("T", " ")[:16]
         status = "stale" if rec.get("stale") else "live"
         lines.append(f"| `{tag}` | {value} | {', '.join(extras) or '—'} "

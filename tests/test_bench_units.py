@@ -392,7 +392,7 @@ def test_decode_child_reports_step_usage(tmp_path):
     env = dict(os.environ)
     for var in ("TS_BENCH_CHILD", "BENCH_BATCH", "BENCH_PRESET",
                 "BENCH_FAMILY", "TS_PALLAS", "BENCH_NO_RECORD",
-                "TS_BEAM_LOOP"):
+                "TS_BEAM_LOOP", "BENCH_STOP_BIAS", "BENCH_DECODE_FIXTURE"):
         env.pop(var, None)
     env.update(BENCH_MODE="decode", BENCH_PRESET="tiny", BENCH_STEPS="2",
                BENCH_BATCH="2", BENCH_ATTEMPTS="1", BENCH_TIMEOUT="240",
@@ -404,10 +404,84 @@ def test_decode_child_reports_step_usage(tmp_path):
         env=env, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
-    # random params never emit STOP, so every hypothesis runs the full
-    # budget — exactly the caveat the fields exist to expose
     assert rec["max_dec_steps"] >= rec["gen_steps_max"]
     assert rec["gen_steps_max"] >= rec["gen_steps_p50"] >= 1
     assert rec["config_fingerprint"]["mode"] == "decode"
+    # STOP-capable params are the default (VERDICT r4 weak #1): the
+    # record and fingerprint both carry the params source so a
+    # worst-case random-init measurement can never be cross-substituted
+    assert rec["params_source"].startswith("stop_bias:")
+    assert rec["config_fingerprint"]["params"] == rec["params_source"]
     lines = [json.loads(s) for s in path.read_text().strip().splitlines()]
     assert len(lines) == 1 and lines[0] == rec
+
+
+def test_decode_params_spec_fixture_detection(tmp_path, monkeypatch):
+    """'fixture' exactly when the family's fixture file exists (or
+    BENCH_DECODE_FIXTURE points at one); ''/'0'/'none' disable; else the
+    calibrated stop-bias spec with the env-overridable magnitude."""
+    monkeypatch.delenv("BENCH_DECODE_FIXTURE", raising=False)
+    monkeypatch.delenv("BENCH_STOP_BIAS", raising=False)
+    assert bench._decode_params_spec("no_such_family") == "stop_bias:6"
+    monkeypatch.setenv("BENCH_STOP_BIAS", "5.5")
+    assert bench._decode_params_spec("no_such_family") == "stop_bias:5.5"
+    fx = tmp_path / "fx.npz"
+    fx.write_bytes(b"")
+    monkeypatch.setenv("BENCH_DECODE_FIXTURE", str(fx))
+    assert bench._decode_params_spec("no_such_family") == "fixture"
+    monkeypatch.setenv("BENCH_DECODE_FIXTURE", "none")
+    assert bench._decode_params_spec("no_such_family") == "stop_bias:5.5"
+    # an explicitly requested fixture that is missing must fail loudly,
+    # never silently degrade to stop-bias params
+    monkeypatch.setenv("BENCH_DECODE_FIXTURE", str(tmp_path / "absent.npz"))
+    with pytest.raises(ValueError, match="does not exist"):
+        bench._decode_params_spec("no_such_family")
+    # default-path auto-detection is gated to the reference preset (the
+    # fixture is reference-scale; a tiny smoke run must not pick it up)
+    monkeypatch.delenv("BENCH_DECODE_FIXTURE")
+    monkeypatch.setenv("BENCH_PRESET", "tiny")
+    assert bench._decode_params_spec(
+        "no_such_family") == "stop_bias:5.5"
+
+
+def test_stop_biased_bumps_only_vocab_sized_bias_vectors():
+    import jax.numpy as jnp
+
+    from textsummarization_on_flink_tpu.data.vocab import STOP_ID
+
+    vsize = 64
+    params = {"out_bias": jnp.zeros((vsize,)),
+              "w": jnp.zeros((4, vsize)),  # matrix: untouched
+              "other": jnp.zeros((vsize + 1,))}
+    out = bench._stop_biased(params, vsize, 3.0)
+    assert float(out["out_bias"][STOP_ID]) == 3.0
+    assert float(jnp.sum(jnp.abs(out["out_bias"]))) == 3.0
+    assert float(jnp.sum(jnp.abs(out["w"]))) == 0.0
+    assert float(jnp.sum(jnp.abs(out["other"]))) == 0.0
+
+
+def test_load_decode_fixture_roundtrip_and_shape_guard(tmp_path):
+    import jax
+    import numpy as np
+
+    init = {"a": {"b": np.zeros((2, 3), np.float32)},
+            "c": [np.ones((4,), np.float32)]}
+    flat, _ = jax.tree_util.tree_flatten_with_path(init)
+    path = tmp_path / "fx.npz"
+    np.savez(path, **{jax.tree_util.keystr(k): v * 2 + 1
+                      for k, v in flat})
+    out = bench._load_decode_fixture(str(path), init)
+    assert np.allclose(out["a"]["b"], 1.0) and np.allclose(out["c"][0], 3.0)
+    # wrong-scale fixture fails loudly
+    bad = {"a": {"b": np.zeros((2, 3), np.float32)},
+           "c": [np.ones((5,), np.float32)]}
+    with pytest.raises(ValueError, match="shape"):
+        bench._load_decode_fixture(str(path), bad)
+    # model grew a leaf the fixture lacks -> missing
+    grown = dict(init, d=np.zeros((1,), np.float32))
+    with pytest.raises(ValueError, match="missing"):
+        bench._load_decode_fixture(str(path), grown)
+    # fixture holds leaves the model no longer has (different config,
+    # e.g. coverage) -> fails loudly instead of silently partial-loading
+    with pytest.raises(ValueError, match="keys the model does not"):
+        bench._load_decode_fixture(str(path), {"a": {"b": init["a"]["b"]}})

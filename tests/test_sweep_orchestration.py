@@ -15,22 +15,32 @@ REPO = os.path.join(os.path.dirname(__file__), "..")
 
 STUB_BENCH = '''
 import datetime, json, os
-mode = os.environ.get("BENCH_MODE", "train")
-rec = {"metric": "stub_" + mode, "value": 1.0, "unit": "x",
-       "vs_baseline": 1.0,
-       "captured_at": datetime.datetime.now(datetime.timezone.utc)
-       .strftime("%Y-%m-%dT%H:%M:%SZ"),
-       "config_fingerprint": {"mode": mode}}
-if os.environ.get("BENCH_RUN_TAG"):
-    rec["run"] = os.environ["BENCH_RUN_TAG"]
-path = os.environ.get(
-    "BENCH_STALE_FILE",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                 "BENCH_ALL.jsonl"))
-if not os.environ.get("BENCH_NO_RECORD"):
-    with open(path, "a") as f:
-        f.write(json.dumps(rec) + "\\n")
-print(json.dumps(rec))
+
+
+def _config_fingerprint():
+    # part of the real contract: the sweep's incremental-skip check
+    # imports bench and compares the banked record's fingerprint to
+    # this (so imports must be side-effect free — main guard below)
+    return {"mode": os.environ.get("BENCH_MODE", "train")}
+
+
+if __name__ == "__main__":
+    mode = os.environ.get("BENCH_MODE", "train")
+    rec = {"metric": "stub_" + mode, "value": 1.0, "unit": "x",
+           "vs_baseline": 1.0,
+           "captured_at": datetime.datetime.now(datetime.timezone.utc)
+           .strftime("%Y-%m-%dT%H:%M:%SZ"),
+           "config_fingerprint": _config_fingerprint()}
+    if os.environ.get("BENCH_RUN_TAG"):
+        rec["run"] = os.environ["BENCH_RUN_TAG"]
+    path = os.environ.get(
+        "BENCH_STALE_FILE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_ALL.jsonl"))
+    if not os.environ.get("BENCH_NO_RECORD"):
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\\n")
+    print(json.dumps(rec))
 '''
 
 
@@ -84,18 +94,28 @@ def test_sweep_writes_every_row_once_and_completeness_passes(tmp_path):
 
 def test_sweep_skips_already_live_rows_incrementally(tmp_path):
     """Tunnel windows can be ~2 min; each pass must bank NEW rows, not
-    re-measure banked ones.  A pre-seeded live train_b16 is skipped
-    (exactly one record for its tag after the pass), stale/error seeds
-    are re-run, and BENCH_FORCE=1 re-measures everything."""
+    re-measure banked ones.  A pre-seeded live train_b16 with a MATCHING
+    fingerprint is skipped (but re-measured once at the paired-denominator
+    point, since lever rows banked in this pass); a live seed whose
+    fingerprint MISMATCHES the row's current config is re-measured
+    (ADVICE r4: a perf-default flip must not serve old-config records
+    forever); stale/error seeds are re-run; BENCH_FORCE=1 re-measures
+    everything."""
     repo = _scratch_repo(tmp_path)
     seed = [
         {"metric": "stub_train", "value": 9.0, "unit": "x",
          "vs_baseline": 1.0, "captured_at": "2026-07-31T00:00:00Z",
-         "run": "train_b16"},
+         "config_fingerprint": {"mode": "train"}, "run": "train_b16"},
         {"metric": "stub_train", "value": 0.0, "unit": "x",
          "vs_baseline": 0.0, "captured_at": "2026-07-31T00:00:01Z",
          "stale": True, "run": "train_b64"},
         {"run": "decode_b4", "error": "boom"},
+        # live but measured under a different config (fingerprint
+        # mismatch) -> must be re-measured, never skipped
+        {"metric": "stub_trainer", "value": 7.0, "unit": "x",
+         "vs_baseline": 1.0, "captured_at": "2026-07-31T00:00:02Z",
+         "config_fingerprint": {"mode": "trainer", "spd": 99},
+         "run": "trainer_e2e"},
     ]
     (repo / "BENCH_ALL.jsonl").write_text(
         "".join(json.dumps(r) + "\n" for r in seed))
@@ -108,10 +128,16 @@ def test_sweep_skips_already_live_rows_incrementally(tmp_path):
     per_tag = {}
     for rec in lines:
         per_tag.setdefault(rec.get("run"), []).append(rec)
-    # live seed skipped: still exactly the one seeded record, value 9.0
-    assert len(per_tag["train_b16"]) == 1
-    assert per_tag["train_b16"][0]["value"] == 9.0
+    # live seed skipped in the main row list... but because lever rows
+    # banked in this pass while the denominator was skipped-as-live, one
+    # paired train_b16 re-measure lands at the end of the lever section
     assert "skipped" in proc.stderr
+    assert per_tag["train_b16"][0]["value"] == 9.0
+    assert len(per_tag["train_b16"]) == 2, \
+        "expected the seed plus exactly one paired denominator re-measure"
+    assert "re-measuring the denominator" in proc.stderr
+    # fingerprint-mismatched live seed re-measured (not skipped)
+    assert any(r["value"] == 1.0 for r in per_tag["trainer_e2e"])
     # stale and error seeds re-measured live
     assert any(not r.get("stale") for r in per_tag["train_b64"])
     assert any("error" not in r for r in per_tag["decode_b4"])
@@ -165,12 +191,13 @@ def test_sweep_appends_error_stub_so_watcher_retries(tmp_path):
     repo = _scratch_repo(tmp_path)
     # stub that errors for decode modes only, succeeds otherwise
     (repo / "bench.py").write_text(STUB_BENCH.replace(
-        'mode = os.environ.get("BENCH_MODE", "train")',
-        'mode = os.environ.get("BENCH_MODE", "train")\n'
-        'if mode == "decode":\n'
-        '    print(json.dumps({"metric": "x", "value": 0.0, "unit": "n/a",\n'
-        '                      "vs_baseline": 0.0, "error": "boom"}))\n'
-        '    raise SystemExit(1)'))
+        '    mode = os.environ.get("BENCH_MODE", "train")',
+        '    mode = os.environ.get("BENCH_MODE", "train")\n'
+        '    if mode == "decode":\n'
+        '        print(json.dumps({"metric": "x", "value": 0.0,\n'
+        '                          "unit": "n/a", "vs_baseline": 0.0,\n'
+        '                          "error": "boom"}))\n'
+        '        raise SystemExit(1)'))
     proc = subprocess.run(["bash", "scripts/bench_all.sh"], cwd=repo,
                           env=_run_env(),
                           capture_output=True, text=True, timeout=120)
