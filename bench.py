@@ -212,6 +212,26 @@ def _config_fingerprint() -> dict:
     return fp
 
 
+_digest_cache: dict = {}
+
+
+def _file_digest(path: str) -> str:
+    """Short content digest of a fixture file, cached on (size, mtime)
+    so the per-row sweep liveness checks don't re-hash tens of MB."""
+    import hashlib
+
+    st = os.stat(path)
+    key = (path, st.st_size, int(st.st_mtime))
+    if key not in _digest_cache:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        _digest_cache.clear()  # one fixture per process in practice
+        _digest_cache[key] = h.hexdigest()[:12]
+    return _digest_cache[key]
+
+
 def _decode_fixture_path(family: str) -> str:
     """Trained decode fixture for BENCH_MODE=decode (generated by
     exp/train_decode_fixture.py; deliberately untracked — the script is
@@ -245,7 +265,11 @@ def _decode_params_spec(family: str) -> str:
                  or (os.environ.get("BENCH_PRESET", "ref") or "ref") == "ref")
     if preset_ok and path and path.lower() not in ("0", "none"):
         if os.path.exists(path):
-            return "fixture"
+            # the spec carries the fixture's content identity: a
+            # REGENERATED fixture (different --steps/--seed => different
+            # gen-step distribution and latency) must invalidate banked
+            # decode rows, not cross-substitute them
+            return f"fixture:{_file_digest(path)}"
         if explicit:
             # an explicitly requested fixture must never silently degrade
             # to stop-bias params — the banked rows would masquerade as
@@ -668,7 +692,7 @@ def _load_decode_fixture(path: str, init):
             raise ValueError(
                 f"decode fixture {path} leaf {key!r} has shape {arr.shape}, "
                 f"model expects {leaf.shape} (wrong scale? regenerate)")
-        leaves.append(arr.astype(np.asarray(leaf).dtype))
+        leaves.append(arr.astype(leaf.dtype))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -696,7 +720,7 @@ def bench_decode() -> None:
     family = get_family(hps.model_family)
     params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(0))
     params_spec = _decode_params_spec(hps.model_family)
-    if params_spec == "fixture":
+    if params_spec.startswith("fixture"):
         params = _load_decode_fixture(
             _decode_fixture_path(hps.model_family), params)
     else:

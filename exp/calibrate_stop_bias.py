@@ -13,7 +13,6 @@ import numpy as np
 
 sys.path.insert(0, ".")
 from textsummarization_on_flink_tpu.config import HParams
-from textsummarization_on_flink_tpu.data.vocab import STOP_ID
 from textsummarization_on_flink_tpu.decode import beam_search
 from textsummarization_on_flink_tpu.models import get_family
 from __graft_entry__ import _example_arrays
@@ -30,15 +29,11 @@ arrays = {k: v for k, v in arrays.items()
 
 
 def with_bias(params, b):
-    def bump(path, x):
-        return x.at[STOP_ID].add(b) if path else x
-    p = jax.tree_util.tree_map(lambda x: x, params)
-    if family_name == "transformer":
-        p["out_bias"] = p["out_bias"].at[STOP_ID].add(b)
-    else:
-        p["output_projection"]["v"] = (
-            p["output_projection"]["v"].at[STOP_ID].add(b))
-    return p
+    # the SAME bias application the decode bench uses — calibrating a
+    # different code path would make the calibrated default meaningless
+    import bench
+
+    return bench._stop_biased(params, hps.vocab_size, b)
 
 
 for b in [float(x) for x in (sys.argv[2:] or

@@ -426,9 +426,16 @@ def test_decode_params_spec_fixture_detection(tmp_path, monkeypatch):
     monkeypatch.setenv("BENCH_STOP_BIAS", "5.5")
     assert bench._decode_params_spec("no_such_family") == "stop_bias:5.5"
     fx = tmp_path / "fx.npz"
-    fx.write_bytes(b"")
+    fx.write_bytes(b"one fixture")
     monkeypatch.setenv("BENCH_DECODE_FIXTURE", str(fx))
-    assert bench._decode_params_spec("no_such_family") == "fixture"
+    spec1 = bench._decode_params_spec("no_such_family")
+    assert spec1.startswith("fixture:") and len(spec1.split(":")[1]) == 12
+    # a REGENERATED fixture (different content) must change the spec so
+    # banked decode rows are invalidated, not cross-substituted
+    fx.write_bytes(b"another fixture, retrained")
+    os.utime(fx, (1, 1))  # force a distinct (size,mtime) cache key
+    spec2 = bench._decode_params_spec("no_such_family")
+    assert spec2.startswith("fixture:") and spec2 != spec1
     monkeypatch.setenv("BENCH_DECODE_FIXTURE", "none")
     assert bench._decode_params_spec("no_such_family") == "stop_bias:5.5"
     # an explicitly requested fixture that is missing must fail loudly,

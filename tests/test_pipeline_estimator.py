@@ -16,6 +16,7 @@ import pytest
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.data.vocab import Vocab
 from textsummarization_on_flink_tpu.pipeline import estimator as est_lib
+from textsummarization_on_flink_tpu.pipeline import params as P_lib
 from textsummarization_on_flink_tpu.pipeline.io import (
     CollectionSink,
     CollectionSource,
@@ -114,6 +115,112 @@ def test_pipeline_estimator_and_model_single_job(tmp_path, vocab):
     assert isinstance(fitted.stages[0], est_lib.SummarizationModel)
     sink = fitted.transform(CollectionSource(article_rows(4)))
     assert len(sink.rows) == 4
+
+
+class SelectColTransformer(est_lib.Model, P_lib.HasTrainSelectedCols):
+    """The reference test's column-subset transformer
+    (TensorFlowTest.java:268-279: input.select(trainSelectedCols))."""
+
+    def __init__(self):
+        P_lib.WithParams.__init__(self)
+
+    def transform(self, source, sink=None):
+        sink = sink if sink is not None else CollectionSink()
+        cols = self.get_train_selected_cols()
+        for row in source.rows():
+            sink.write(source.schema.project_row(row, cols))
+        sink.close()
+        return sink
+
+    def output_schema(self, input_schema):
+        return input_schema.select(self.get_train_selected_cols())
+
+
+class _RecordingEstimator(est_lib.Estimator):
+    """Records the exact rows/schema fit() received, returning a no-op
+    Model — pins Pipeline.fit's stage-chaining contract in isolation."""
+
+    def __init__(self):
+        P_lib.WithParams.__init__(self)
+        self.seen_rows = None
+        self.seen_schema = None
+
+    def fit(self, source):
+        self.seen_rows = list(source.rows())
+        self.seen_schema = source.schema
+
+        class _Identity(est_lib.Model):
+            def __init__(self):
+                P_lib.WithParams.__init__(self)
+
+            def transform(self, source, sink=None):
+                sink = sink if sink is not None else CollectionSink()
+                for row in source.rows():
+                    sink.write(row)
+                sink.close()
+                return sink
+
+        return _Identity()
+
+
+def test_pipeline_fit_chains_stage_outputs():
+    """flink-ml Pipeline.fit semantics: an Estimator is fitted on the
+    table as transformed by every preceding stage, not the raw source
+    (round-4 review: transformers used to pass sources through
+    unchanged, so SelectColTransformer->estimator fitted on the
+    UNtransformed table)."""
+    sel = SelectColTransformer().set_train_selected_cols(
+        ["uuid", "article", "reference"])
+    rec = _RecordingEstimator()
+    fitted = est_lib.Pipeline([sel, rec]).fit(
+        CollectionSource(article_rows(3)))
+    # the estimator saw 3-col rows (summary dropped) + narrowed schema
+    assert rec.seen_rows == [(f"uuid-{i}", f"article {i} .",
+                              f"reference {i} .") for i in range(3)]
+    assert rec.seen_schema.names == ["uuid", "article", "reference"]
+    # the fitted pipeline keeps the transformer + the fitted model, in order
+    assert fitted.stages[0] is sel
+    assert isinstance(fitted.stages[1], est_lib.Model)
+    assert not isinstance(fitted.stages[1], est_lib.Estimator)
+
+
+def test_pipeline_fit_is_lazy_without_downstream_estimator():
+    """A Model AFTER the last Estimator is never transform()ed during
+    fit — the common estimator->model pipeline must not beam-decode its
+    own training set (flink-ml materializes stage outputs only as later
+    stages consume them)."""
+
+    class _Exploding(est_lib.Model):
+        def __init__(self):
+            P_lib.WithParams.__init__(self)
+
+        def transform(self, source, sink=None):
+            raise AssertionError("fit must not transform trailing stages")
+
+    rec = _RecordingEstimator()
+    fitted = est_lib.Pipeline([rec, _Exploding()]).fit(
+        CollectionSource(article_rows(2)))
+    assert len(rec.seen_rows) == 2  # 4-col raw rows, no prior stages
+    assert len(fitted.stages) == 2
+
+
+@pytest.mark.slow
+def test_pipeline_select_col_then_estimator_end_to_end(tmp_path, vocab):
+    """The exact shape TensorFlowTest.testPipeline (:170-202) wanted and
+    couldn't run: Pipeline(SelectColTransformer -> estimator), fit on the
+    8-row table, then transform the fitted pipeline — one process."""
+    sel = SelectColTransformer().set_train_selected_cols(
+        ["uuid", "article", "reference"])
+    pipe = est_lib.Pipeline([sel, make_estimator(tmp_path, vocab)])
+    fitted = pipe.fit(CollectionSource(article_rows()))
+    assert isinstance(fitted.stages[1], est_lib.SummarizationModel)
+    sink = fitted.transform(CollectionSource(article_rows(4)))
+    assert len(sink.rows) == 4
+    for uuid, article, summary, reference in sink.rows:
+        assert uuid.startswith("uuid-")
+        assert article.startswith("article")
+        assert isinstance(summary, str)
+        assert reference.startswith("reference")
 
 
 @pytest.mark.slow

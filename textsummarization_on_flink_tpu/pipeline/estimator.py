@@ -124,6 +124,15 @@ class Model(PipelineStage):
     def transform(self, source: Source, sink: Optional[Sink] = None) -> Sink:
         raise NotImplementedError
 
+    def output_schema(self, input_schema: "RowSchema") -> "RowSchema":
+        """Schema of transform()'s output rows, given the input table's —
+        Pipeline chaining wraps intermediate rows in a CollectionSource
+        with this.  Pass-through by default (column-preserving
+        transformers); stages that reshape the table override
+        (SummarizationModel emits the 4-col article-output schema;
+        a column-subset transformer narrows it)."""
+        return input_schema
+
 
 class _BridgeFeeder:
     """Driver-side feed pump: source rows -> coded records -> RecordQueue.
@@ -222,6 +231,13 @@ class SummarizationModel(Model,
         if self._vocab_override is not None:
             return self._vocab_override
         return Vocab(hps.vocab_path, hps.vocab_size)
+
+    def output_schema(self, input_schema: RowSchema) -> RowSchema:
+        from textsummarization_on_flink_tpu.pipeline.io import (
+            ARTICLE_OUTPUT_SCHEMA,
+        )
+
+        return ARTICLE_OUTPUT_SCHEMA  # (uuid, article, summary, reference)
 
     def transform(self, source: Source, sink: Optional[Sink] = None,
                   max_batches: int = 0) -> Sink:
@@ -356,33 +372,52 @@ class Pipeline:
         self.stages.append(stage)
         return self
 
+    @staticmethod
+    def _apply(stage: Model, source: Source) -> Source:
+        """Run one Model/Transformer stage, materializing its output rows
+        as the next stage's source (the reference pipeline re-streams
+        tables between stages)."""
+        from textsummarization_on_flink_tpu.pipeline.io import (
+            CollectionSource,
+        )
+
+        mid = stage.transform(source, CollectionSink())
+        return CollectionSource(mid.rows,
+                                schema=stage.output_schema(source.schema))
+
     def fit(self, source: Source) -> "Pipeline":
-        """Fit every estimator in order; transformers pass sources through
-        unchanged (the reference pipeline re-streams tables between
-        stages)."""
+        """Fit every Estimator on the table as transformed by every
+        PRECEDING stage — flink-ml Pipeline.fit semantics, and the
+        SelectColTransformer->estimator shape TensorFlowTest.testPipeline
+        (:170-202) wanted but had to comment out.  Chaining is lazy: the
+        preceding Transformers/Models materialize into a CollectionSource
+        only when a later Estimator actually fits, so the common
+        estimator->model pipeline never beam-decodes its own training
+        set just to produce an output nobody consumes."""
         fitted: List[PipelineStage] = []
+        cur_source = source
+        pending: List[Model] = []  # stages not yet applied to cur_source
         for stage in self.stages:
             if isinstance(stage, Estimator):
-                fitted.append(stage.fit(source))
+                for prior in pending:
+                    cur_source = self._apply(prior, cur_source)
+                pending = []
+                model = stage.fit(cur_source)
+                fitted.append(model)
+                pending.append(model)
             else:
                 fitted.append(stage)
+                pending.append(stage)
         return Pipeline(fitted)
 
     def transform(self, source: Source, sink: Optional[Sink] = None) -> Sink:
         """Chain every Model stage: each stage's output rows become the
         next stage's source; the last stage writes into `sink`."""
-        from textsummarization_on_flink_tpu.pipeline.io import (
-            ARTICLE_OUTPUT_SCHEMA,
-            CollectionSource,
-        )
-
         models = [s for s in self.stages if isinstance(s, Model)]
         if not models:
             raise ValueError("pipeline has no Model stage to transform with")
         out = sink if sink is not None else CollectionSink()
         cur_source = source
         for stage in models[:-1]:
-            mid = stage.transform(cur_source, CollectionSink())
-            cur_source = CollectionSource(mid.rows,
-                                          schema=ARTICLE_OUTPUT_SCHEMA)
+            cur_source = self._apply(stage, cur_source)
         return models[-1].transform(cur_source, out)
