@@ -83,6 +83,12 @@ PYEOF
       else
         echo "[watch] auto-commit FAILED (rc=$?) — records remain in the working tree"
       fi
+      # the window may still be open: capture the TPU-compiled roofline
+      # attribution + a profiler trace (scripts/capture_window_extras.sh,
+      # idempotent).  Strictly after the rows are committed — the
+      # diagnostics must never cost a banked number.
+      bash scripts/capture_window_extras.sh \
+        || echo "[watch] window extras incomplete (rc=$?)"
       exit 0
     fi
     echo "[watch] sweep incomplete; will retry"

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Tunnel-window extras, run by bench_when_up.sh AFTER the sweep is
+# complete and its rows are committed (never before — banked numbers
+# outrank diagnostics).  Two captures, both idempotent (skipped once
+# their output exists), both pure capture — the analysis/BASELINE.md
+# write-up happens offline where no tunnel window is being spent:
+#
+#  1. exp/roofline_tpu.json — XLA cost_analysis of the real train step
+#     compiled ON THE TPU BACKEND, with per-phase attribution.  The
+#     roofline/attribution story so far rests on CPU-compiled HLO byte
+#     estimates that the one measured row already proved ~10% optimistic
+#     (13.37 ms measured vs 14.8 ms CPU-HLO "floor" — TPU fusion decides
+#     the real byte traffic, VERDICT r4 weak #4).
+#  2. exp/trace_r05/ — a TS_PROFILE_DIR profiler trace captured through
+#     a short end-to-end Trainer run (BENCH_MODE=trainer drives the real
+#     Trainer, which starts/stops jax.profiler at dispatch boundaries,
+#     train/trainer.py:482-528) for op-level arbitration.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p exp
+
+if [ ! -s exp/roofline_tpu.json ]; then
+  echo "[extras] TPU-compiled roofline attribution (train_b16 + train_transformer)"
+  if timeout 900 python scripts/roofline.py \
+      --configs train_b16,train_transformer --attribute --json \
+      > exp/roofline_tpu.json.tmp 2> exp/roofline_tpu.log; then
+    mv exp/roofline_tpu.json.tmp exp/roofline_tpu.json
+    echo "[extras] roofline_tpu.json captured"
+  else
+    echo "[extras] TPU roofline failed (rc=$?) — see exp/roofline_tpu.log"
+  fi
+fi
+
+if [ ! -d exp/trace_r05 ] || [ -z "$(ls -A exp/trace_r05 2>/dev/null)" ]; then
+  echo "[extras] profiler trace via a short e2e trainer run"
+  rm -rf exp/trace_r05.tmp
+  # success = the profiler actually wrote an xplane file, NOT bench.py's
+  # exit code: the supervisor exits 0 on its stale-fallback path (a
+  # tunnel drop mid-trace would serve the sweep's just-banked
+  # trainer_e2e row), which would bank a truncated trace forever
+  if env TS_PROFILE_DIR="$PWD/exp/trace_r05.tmp" BENCH_NO_RECORD=1 \
+      BENCH_STALE_FILE=/dev/null \
+      BENCH_MODE=trainer BENCH_STEPS=24 BENCH_ATTEMPTS=1 \
+      BENCH_TIMEOUT=600 timeout 700 python bench.py \
+      > exp/trace_bench.out 2>&1 \
+      && find exp/trace_r05.tmp -name "*.xplane.pb" | grep -q .; then
+    mv exp/trace_r05.tmp exp/trace_r05
+    echo "[extras] trace captured -> exp/trace_r05"
+  else
+    echo "[extras] trace capture failed — see exp/trace_bench.out"
+  fi
+fi
+echo "[extras] done"
