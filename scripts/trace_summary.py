@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Summarize a TS_PROFILE_DIR profiler capture into the op-level table
+BASELINE.md's arbitration asks for (top ops by device time, per lane).
+
+    python scripts/trace_summary.py [exp/trace_r05] [--top 15] [--json]
+
+Reads the Chrome-trace JSON (`*.trace.json.gz`) that `jax.profiler`
+writes next to the xplane file (TensorBoard not required — the rig has
+no tensorboard_plugin_profile, so this parses the portable format).
+Events are grouped into lanes (one per process/pid: TPU device lanes,
+host threads); within a lane, complete events ('ph': 'X') are summed by
+name.  Python host-frame events (names like `$threading.py:323 wait`)
+are dropped from per-op tables by default — on a device lane the names
+are XLA ops/fusions, which is the table that names the bottleneck op
+(e.g. the transformer <6%-MFU escalation in BASELINE.md).
+
+The capture itself happens inside a tunnel window via
+scripts/capture_window_extras.sh; this summarizer runs offline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def find_trace_files(root: str) -> list:
+    pats = [os.path.join(root, "**", "*.trace.json.gz"),
+            os.path.join(root, "**", "*.trace.json")]
+    files: list = []
+    for p in pats:
+        files.extend(glob.glob(p, recursive=True))
+    return sorted(files)
+
+
+def load_events(path: str) -> dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def summarize(trace: dict, include_host_frames: bool = False) -> list:
+    """Per-lane op-time summary, one lane per (pid, tid) thread line.
+
+    Grouping by pid alone would double-count: the profiler's export
+    gives a device several lines (e.g. a module/step-level line whose
+    events span the same wall time as the per-op line), so summing
+    across a pid's tids inflates busy time and the enclosing module
+    event would top the \"op\" table.  Per-thread lanes keep each line
+    honest; the op line is the one whose names are XLA ops/fusions.
+
+    Returns [{lane, pid, tid, busy_us, ops: [{name, total_us, count}]}]
+    sorted by lane busy time, descending.
+    """
+    proc_names: dict = {}
+    thread_names: dict = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            proc_names[e.get("pid")] = e.get("args", {}).get("name", "?")
+        elif e.get("name") == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = (
+                e.get("args", {}).get("name", "?"))
+
+    per_lane: dict = defaultdict(lambda: defaultdict(lambda: [0.0, 0]))
+    busy: dict = defaultdict(float)
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name", "?")
+        if not include_host_frames and name.startswith("$"):
+            continue  # python host frames, not ops
+        dur = float(e.get("dur", 0.0))
+        key = (e.get("pid"), e.get("tid"))
+        cell = per_lane[key][name]
+        cell[0] += dur
+        cell[1] += 1
+        busy[key] += dur
+    out = []
+    for (pid, tid), ops in per_lane.items():
+        proc = proc_names.get(pid, str(pid))
+        thread = thread_names.get((pid, tid))
+        out.append({
+            "lane": f"{proc}/{thread}" if thread else proc,
+            "pid": pid,
+            "tid": tid,
+            "busy_us": round(busy[(pid, tid)], 1),
+            "ops": sorted(
+                ({"name": n, "total_us": round(t, 1), "count": c}
+                 for n, (t, c) in ops.items()),
+                key=lambda o: -o["total_us"]),
+        })
+    out.sort(key=lambda lane: -lane["busy_us"])
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir", nargs="?", default="exp/trace_r05")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--host-frames", action="store_true",
+                    help="keep $file:line python-frame events")
+    args = ap.parse_args(argv)
+
+    files = find_trace_files(args.trace_dir)
+    if not files:
+        print(f"no *.trace.json[.gz] under {args.trace_dir} — capture one "
+              f"in a tunnel window (scripts/capture_window_extras.sh)",
+              file=sys.stderr)
+        return 1
+    path = files[-1]  # newest capture wins (sorted paths are dated)
+    lanes = summarize(load_events(path), args.host_frames)
+    if args.json:
+        print(json.dumps({"trace": path, "lanes": [
+            {**lane, "ops": lane["ops"][:args.top]} for lane in lanes]}))
+        return 0
+    print(f"trace: {path}")
+    for lane in lanes:
+        if not lane["ops"]:
+            continue
+        print(f"\nlane {lane['lane']!r} (pid {lane['pid']} "
+              f"tid {lane['tid']}, busy {lane['busy_us'] / 1e3:.1f} ms):")
+        for op in lane["ops"][:args.top]:
+            pct = 100.0 * op["total_us"] / max(lane["busy_us"], 1e-9)
+            print(f"  {op['total_us'] / 1e3:>9.2f} ms {pct:>5.1f}%  "
+                  f"x{op['count']:<5} {op['name'][:80]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,98 @@
+"""scripts/trace_summary.py: the offline summarizer for TS_PROFILE_DIR
+captures (scripts/capture_window_extras.sh banks the trace in a tunnel
+window; the summary names the bottleneck op for BASELINE.md)."""
+
+import gzip
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import trace_summary  # noqa: E402
+
+
+def _write_trace(path, events):
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+@pytest.fixture
+def trace_dir(tmp_path):
+    d = tmp_path / "cap" / "plugins" / "profile" / "2026_07_31_00_00_00"
+    d.mkdir(parents=True)
+    _write_trace(d / "vm.trace.json.gz", [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        # device OP line: fusion dominates
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.42",
+         "ts": 0, "dur": 900.0},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.42",
+         "ts": 1000, "dur": 600.0},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "copy.3",
+         "ts": 2000, "dur": 100.0},
+        # device MODULE line: one enclosing event spanning the same wall
+        # time — must NOT be summed into the op line (double count)
+        {"ph": "X", "pid": 1, "tid": 2, "name": "jit_multi",
+         "ts": 0, "dur": 2100.0},
+        # host lane: one op event + python frames (dropped by default)
+        {"ph": "X", "pid": 2, "tid": 9, "name": "PjitFunction(multi)",
+         "ts": 0, "dur": 50.0},
+        {"ph": "X", "pid": 2, "tid": 9, "name": "$threading.py:323 wait",
+         "ts": 0, "dur": 5000.0},
+        # non-X events are ignored
+        {"ph": "B", "pid": 1, "tid": 1, "name": "ignored", "ts": 0},
+    ])
+    return tmp_path / "cap"
+
+
+def test_summarize_groups_ops_per_thread_lane_and_drops_host_frames(
+        trace_dir):
+    files = trace_summary.find_trace_files(str(trace_dir))
+    assert len(files) == 1
+    lanes = trace_summary.summarize(trace_summary.load_events(files[0]))
+    assert [lane["lane"] for lane in lanes] == [
+        "/device:TPU:0/XLA Modules", "/device:TPU:0/XLA Ops", "/host:CPU"]
+    mod, dev, host = lanes
+    # the module line stays its own lane: its enclosing event neither
+    # inflates the op line's busy time nor tops its op table
+    assert mod["ops"] == [{"name": "jit_multi", "total_us": 2100.0,
+                           "count": 1}]
+    # fusion.42 aggregated across occurrences, ops sorted by total time
+    assert dev["ops"][0] == {"name": "fusion.42", "total_us": 1500.0,
+                             "count": 2}
+    assert dev["ops"][1]["name"] == "copy.3"
+    assert dev["busy_us"] == 1600.0
+    # the $python-frame event is dropped: busy time counts real ops only
+    assert [op["name"] for op in host["ops"]] == ["PjitFunction(multi)"]
+    assert host["busy_us"] == 50.0
+    # opt-in keeps the frames
+    lanes_all = trace_summary.summarize(
+        trace_summary.load_events(files[0]), include_host_frames=True)
+    host_all = next(lane for lane in lanes_all if lane["pid"] == 2)
+    assert host_all["busy_us"] == 5050.0
+
+
+def test_cli_renders_table_and_json(trace_dir, capsys):
+    assert trace_summary.main([str(trace_dir), "--top", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "fusion.42" in out and "/device:TPU:0" in out
+    assert "copy.3" not in out  # --top 1
+    assert trace_summary.main([str(trace_dir), "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    ops_lane = next(lane for lane in rec["lanes"]
+                    if lane["lane"].endswith("XLA Ops"))
+    assert ops_lane["ops"][0]["name"] == "fusion.42"
+
+
+def test_cli_errors_without_capture(tmp_path, capsys):
+    assert trace_summary.main([str(tmp_path)]) == 1
+    assert "capture" in capsys.readouterr().err
