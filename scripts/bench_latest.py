@@ -4,16 +4,47 @@
 The sweep file is append-only (scripts/bench_all.sh) so one sweep row
 can appear many times across reruns; BASELINE.md wants the latest view.
 
-    python scripts/bench_latest.py [BENCH_ALL.jsonl] [--json|--md]
+    python scripts/bench_latest.py [BENCH_ALL.jsonl] [--json|--md|--ratios]
 
 Default output is a small aligned table; --json emits one JSON line per
 tag (newest record verbatim) for machine use; --md emits the markdown
 measured table BASELINE.md embeds (so a fresh sweep is publishable by
-paste).
+paste); --ratios computes each A/B lever row against its denominator
+(the numbers PERF.md's predicted-band verdicts are filled from) and
+always prints the capture-time gap between the two — the operator's
+datum for the same-window rule pair_denominator enforces.  A heuristic
+flag marks pairs whose gap makes different tunnel windows likely; its
+ABSENCE is not proof of a same-window pair (windows have been observed
+as short as ~2 min), the gap itself is the judgment call.
 """
 
+import datetime
 import json
 import sys
+
+# lever row -> the denominator its PERF.md band is stated against
+# (scripts/bench_all.sh groups these into pair_denominator sections)
+RATIO_DENOMS = {
+    "decode_b1": "decode_b4",
+    "decode_chunked": "decode_b4",
+    "decode_while": "decode_b4",
+    "decode_transformer": "decode_b4",
+    "train_b16_unroll1": "train_b16",
+    "train_b16_unroll16": "train_b16",
+    "train_b16_pallas": "train_b16",
+    "train_b16_remat": "train_b16",
+    "train_b64": "train_b16",
+    "train_scaled": "train_b16",
+    "train_transformer_flash": "train_transformer",
+    "trainer_e2e": "train_b16",
+    "trainer_e2e_spd1": "train_b16",  # PERF.md states its band vs train_b16
+}
+
+# heuristic only: a sweep section banks its rows plus the paired
+# denominator within a few minutes, so a bigger gap makes different
+# tunnel windows LIKELY (shorter same-window gaps still exist — the
+# printed gap, not the flag, is authoritative)
+PAIR_WARN_SECONDS = 10 * 60
 
 
 def latest_by_tag(path):
@@ -82,6 +113,53 @@ def _md_table(latest):
     return "\n".join(lines)
 
 
+def _parse_ts(rec):
+    try:
+        return datetime.datetime.strptime(
+            rec.get("captured_at", ""), "%Y-%m-%dT%H:%M:%SZ")
+    except ValueError:
+        return None
+
+
+def _ratio_rows(latest):
+    """[(tag, denom, ratio, unit, pair_gap_s|None, flags)] for every
+    lever row whose numerator AND denominator are banked live."""
+    rows = []
+    for tag, denom in RATIO_DENOMS.items():
+        num, den = latest.get(tag), latest.get(denom)
+        if not num or not den:
+            continue
+        if any("error" in r or r.get("stale") for r in (num, den)):
+            continue
+        if not den.get("value"):
+            continue
+        ratio = num["value"] / den["value"]
+        t_num, t_den = _parse_ts(num), _parse_ts(den)
+        gap = (abs((t_num - t_den).total_seconds())
+               if t_num and t_den else None)
+        flags = []
+        if gap is None:
+            flags.append("UNDATED")
+        elif gap > PAIR_WARN_SECONDS:
+            flags.append("LIKELY CROSS-WINDOW")  # re-pair before verdicts
+        rows.append((tag, denom, ratio, num.get("unit", ""), gap, flags))
+    return rows
+
+
+def _print_ratios(latest):
+    rows = _ratio_rows(latest)
+    if not rows:
+        print("no live lever/denominator pairs banked yet")
+        return
+    width = max(len(t) for t, *_ in rows)
+    for tag, denom, ratio, unit, gap, flags in rows:
+        gap_s = "gap ?" if gap is None else f"gap {gap / 60:.1f} min"
+        note = ("  [" + ", ".join(flags) + "]") if flags else ""
+        print(f"{tag:<{width}}  {ratio:6.3f}x vs {denom} "
+              f"({latest[tag]['value']} / {latest[denom]['value']} {unit}; "
+              f"{gap_s}){note}")
+
+
 def main(argv):
     args = [a for a in argv if not a.startswith("--")]
     path = args[0] if args else "BENCH_ALL.jsonl"
@@ -92,6 +170,9 @@ def main(argv):
         return 0
     if "--md" in argv:
         print(_md_table(latest))
+        return 0
+    if "--ratios" in argv:
+        _print_ratios(latest)
         return 0
     width = max((len(t) for t in latest), default=3)
     for tag, rec in latest.items():

@@ -11,6 +11,8 @@ import shutil
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.join(os.path.dirname(__file__), "..")
 
 STUB_BENCH = '''
@@ -182,6 +184,44 @@ def test_bench_latest_md_table(tmp_path):
     assert "step 13.4 ms" in out
     assert "| error |" in out and "tunnel down" in out
     assert "| stale |" in out
+
+
+def test_bench_latest_ratio_view(tmp_path):
+    """--ratios pairs each lever row with its denominator and flags
+    pairs captured in different tunnel windows (the same-window rule
+    pair_denominator enforces; PERF.md verdicts must not be filled from
+    a flagged pair)."""
+    path = tmp_path / "b.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in [
+        {"metric": "m", "value": 1000.0, "unit": "samples/s",
+         "run": "train_b16", "captured_at": "2026-07-31T01:00:00Z"},
+        {"metric": "m", "value": 900.0, "unit": "samples/s",
+         "run": "train_b16_unroll1", "captured_at": "2026-07-31T01:02:00Z"},
+        {"metric": "m", "value": 2500.0, "unit": "samples/s",
+         "run": "train_b64", "captured_at": "2026-07-31T09:00:00Z"},
+        # denominator missing entirely -> row omitted
+        {"metric": "m", "value": 5.0, "unit": "ms",
+         "run": "decode_while", "captured_at": "2026-07-31T01:00:00Z"},
+    ]))
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import importlib
+
+        import bench_latest
+
+        importlib.reload(bench_latest)
+        latest = bench_latest.latest_by_tag(str(path))
+        rows = {t: (d, r, g, f)
+                for t, d, r, _, g, f in bench_latest._ratio_rows(latest)}
+    finally:
+        sys.path.pop(0)
+    assert rows["train_b16_unroll1"][1] == pytest.approx(0.9)
+    assert rows["train_b16_unroll1"][2] == 120.0  # same window
+    assert rows["train_b16_unroll1"][3] == []
+    # 8h apart -> flagged as a likely cross-window pair
+    assert rows["train_b64"][3] == ["LIKELY CROSS-WINDOW"]
+    # decode_while's denominator (decode_b4) is absent -> no row
+    assert "decode_while" not in rows
 
 
 def test_sweep_appends_error_stub_so_watcher_retries(tmp_path):
