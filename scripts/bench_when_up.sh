@@ -4,6 +4,9 @@
 # inside tmux while the tunnel is flapping:
 #     scripts/bench_when_up.sh [interval_seconds]
 # Writes sweep progress to stdout; touches BENCH_SWEEP_DONE on success.
+# After a complete sweep it stays alive in re-bank mode, appending
+# fresh headline rows in later tunnel windows (round-4 review: a
+# headline resting on ONE window is one row).
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${1:-120}"
@@ -12,14 +15,29 @@ unset BENCH_NO_RECORD  # banked rows reach the JSONL via bench.py's append
 # banked headline row away from the BENCH_ALL.jsonl this watcher checks
 unset BENCH_STALE_FILE
 rm -f BENCH_SWEEP_DONE
+
+# ONE probe definition for first-bank and re-bank modes.  40s: a
+# healthy tunnel answers in ~10s; the timeout only bounds the DOWN
+# case, and a shorter one tightens the probe cycle (catching ~2-min
+# windows).  bench_all.sh's mid-sweep abort probe stays at 75s — there
+# a false DOWN verdict costs a whole pass.  PYTHONPATH is deliberately
+# KEPT: the probe must see the real backend (a scrubbed probe would
+# pass on CPU and bank garbage).
+probe() {
+  timeout 40 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
+}
+
+# bank_row TAG MODE TIMEOUT: one headline row via bench.py, which
+# self-appends only LIVE successes (stale fallbacks are printed, never
+# recorded), run-tagged for bench_latest's newest-per-tag view.
+bank_row() {
+  BENCH_MODE="$2" BENCH_ATTEMPTS=1 BENCH_TIMEOUT="$3" \
+    BENCH_RUN_TAG="$1" python bench.py || true
+}
+
 while true; do
   echo "[watch] $(date -u +%H:%M:%S) probing tunnel..."
-  # 40s: a healthy tunnel answers in ~10s; the timeout only bounds the
-  # DOWN case, and a shorter one tightens the probe cycle (catching
-  # ~2-min windows).  bench_all.sh's mid-sweep abort probe stays at 75s
-  # — there a false DOWN verdict costs a whole pass.
-  if timeout 40 python -c "import jax; print(jax.devices())" \
-      >/dev/null 2>&1; then
+  if probe; then
     echo "[watch] tunnel UP — banking the quick headline row first"
     # even a ~5-minute tunnel window must bank the headline train number
     # before the 1-2h sweep starts; bench.py self-appends the success
@@ -36,8 +54,7 @@ PYEOF
     then
       echo "[watch] headline row already live — straight to the sweep"
     else
-      BENCH_MODE=train BENCH_ATTEMPTS=1 BENCH_TIMEOUT=300 \
-        BENCH_RUN_TAG=train_b16 python bench.py || true
+      bank_row train_b16 train 300
     fi
     echo "[watch] starting full sweep"
     bash scripts/bench_all.sh
@@ -89,7 +106,37 @@ PYEOF
       # diagnostics must never cost a banked number.
       bash scripts/capture_window_extras.sh \
         || echo "[watch] window extras incomplete (rc=$?)"
-      exit 0
+      # robustness mode: keep probing at the NORMAL cadence (windows are
+      # ~2 min — one probe per cooldown would catch ~none) and re-bank
+      # the two headline rows (train throughput, decode p50) when a
+      # window is found; the cooldown gates SUCCESSFUL re-banks only, so
+      # each appended record is an independent window's measurement.
+      COOLDOWN="${REBANK_COOLDOWN:-7200}"
+      echo "[watch] entering re-bank mode (probe every ${INTERVAL}s; at most one re-bank per ${COOLDOWN}s)"
+      last_rebank=0
+      while true; do
+        now=$(date +%s)
+        if [ $((now - last_rebank)) -ge "$COOLDOWN" ]; then
+          echo "[watch] $(date -u +%H:%M:%S) re-bank probe..."
+          if probe; then
+            bank_row train_b16 train 300
+            bank_row decode_b4 decode 600
+            # stale fallbacks are printed, never self-appended, so the
+            # file only ever gains LIVE re-measurements here
+            if ! git diff --quiet -- BENCH_ALL.jsonl; then
+              if git commit -q -o BENCH_ALL.jsonl \
+                  -m "Re-banked headline rows in a later tunnel window (watcher auto-commit)"
+              then
+                echo "[watch] re-banked rows committed"
+              else
+                echo "[watch] re-bank auto-commit FAILED (rc=$?) — records remain in the working tree"
+              fi
+              last_rebank=$(date +%s)
+            fi
+          fi
+        fi
+        sleep "$INTERVAL"
+      done
     fi
     echo "[watch] sweep incomplete; will retry"
   fi
