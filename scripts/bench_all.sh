@@ -157,18 +157,25 @@ print(json.dumps(rec))" >> "$OUT"
 # two headline numbers first (train throughput, decode serving latency),
 # then the second family + e2e, then the A/B lever rows.  Already-live
 # rows are skipped (see run()), so this is the order NEW rows bank in.
+# decode rows all get bench.py's own 1200s decode default instead of
+# the 360s sweep cap: the first full-scale beam-search compile (scan or
+# while) can exceed 360s, and a child killed mid-compile writes nothing
+# to the persistent compile cache — the row would then time out
+# identically on every pass (ADVICE r4).  Once compiled, the warm-cache
+# row measures in ~60-90s; a tunnel death mid-row is bounded by the
+# early-abort probe in run().
 run train_b16            BENCH_MODE=train
-run decode_b4            BENCH_MODE=decode
+run decode_b4            BENCH_MODE=decode BENCH_TIMEOUT=1200
 run train_transformer    BENCH_MODE=train BENCH_FAMILY=transformer
 run trainer_e2e          BENCH_MODE=trainer
 # --- decode A/B lever rows, ratioed against decode_b4 (loop-strategy
 # choice + batch-amortization): same-window denominator pairing
 DID_MEASURE=0
-run decode_b1            BENCH_MODE=decode BENCH_BATCH=1
+run decode_b1            BENCH_MODE=decode BENCH_BATCH=1 BENCH_TIMEOUT=1200
 run decode_chunked       BENCH_MODE=decode TS_BEAM_LOOP=chunked BENCH_TIMEOUT=1200
 run decode_while         BENCH_MODE=decode TS_BEAM_LOOP=while BENCH_TIMEOUT=1200
-pair_denominator decode_b4 BENCH_MODE=decode
-run decode_transformer   BENCH_MODE=decode BENCH_FAMILY=transformer
+pair_denominator decode_b4 BENCH_MODE=decode BENCH_TIMEOUT=1200
+run decode_transformer   BENCH_MODE=decode BENCH_FAMILY=transformer BENCH_TIMEOUT=1200
 # --- train A/B lever rows, ratioed against train_b16
 DID_MEASURE=0
 run train_b16_unroll1    BENCH_MODE=train BENCH_UNROLL=1
