@@ -13,7 +13,12 @@ this script is the committed recipe — bench.py's BENCH_MODE=decode
 auto-loads the npz when present, see bench._decode_params_spec):
 
     JAX_PLATFORMS=cpu nice -n 19 python exp/train_decode_fixture.py \
-        [--family pointer_generator] [--steps 300] [--coverage-steps 60]
+        [--family pointer_generator] [--steps 800] [--coverage-steps 80]
+
+Calibration note (2026-07-31): at 300 steps the beam stops at the
+36-step min_dec_steps floor (weak copy confidence makes STOP dominate
+as soon as allowed); at 800 steps (~2h CPU, loss ~2.6) it holds on to
+44 generated steps — a learned, mid-band stopping point.
 
 Writes exp/decode_fixture_<family>.npz (keystr -> array, the layout
 bench._load_decode_fixture validates leaf-for-leaf) and prints the
@@ -127,8 +132,8 @@ def evaluate(params, family_name):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--family", default="pointer_generator")
-    ap.add_argument("--steps", type=int, default=300)
-    ap.add_argument("--coverage-steps", type=int, default=60)
+    ap.add_argument("--steps", type=int, default=800)
+    ap.add_argument("--coverage-steps", type=int, default=80)
     ap.add_argument("--out", default=None)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
