@@ -103,6 +103,11 @@ def _child_env() -> dict:
 
     env = dict(os.environ)
     env["TS_BENCH_CHILD"] = "1"
+    if _obs_snapshot_requested():
+        # --obs-snapshot: the child embeds an obs registry dump in its
+        # result row (argv is not forwarded to the re-exec'd child, so
+        # the flag rides the environment)
+        env["TS_OBS_SNAPSHOT"] = "1"
     repo_root = os.path.dirname(os.path.abspath(__file__))
     set_default_compile_cache(env)
     if env.get("BENCH_MODE") == "input":
@@ -120,6 +125,24 @@ def _env_flag(name: str) -> bool:
     """Boolean env knob: '1'/'on'/'true'/'yes' enable (so '=0' really
     disables — raw truthiness would read '0' as on)."""
     return os.environ.get(name, "").lower() in ("1", "on", "true", "yes")
+
+
+def _obs_snapshot_requested() -> bool:
+    """`python bench.py --obs-snapshot` (or TS_OBS_SNAPSHOT=1): embed a
+    compact obs registry dump in the result row so the BENCH trajectory
+    carries telemetry (OBSERVABILITY.md)."""
+    return "--obs-snapshot" in sys.argv[1:] or _env_flag("TS_OBS_SNAPSHOT")
+
+
+def _obs_extra() -> dict:
+    """The child-side snapshot payload ({} when not requested).  Compact:
+    untouched metrics are dropped, so a train row carries the train-layer
+    metrics only."""
+    if not _obs_snapshot_requested():
+        return {}
+    from textsummarization_on_flink_tpu import obs
+
+    return {"obs_snapshot": obs.snapshot(compact=True)}
 
 
 def _config_fingerprint() -> dict:
@@ -643,6 +666,7 @@ def bench_train() -> None:
         "timing": f"on-device lax.scan of {steps} steps, scalar-fetch fence",
     }
     rec.update(info)
+    rec.update(_obs_extra())
     print(json.dumps(rec))
 
 
@@ -796,6 +820,7 @@ def bench_decode() -> None:
         "max_dec_steps": hps.max_dec_steps,
     }
     rec.update(info)
+    rec.update(_obs_extra())
     print(json.dumps(rec))
 
 
@@ -1072,7 +1097,7 @@ def bench_input() -> None:
             n_batches += 1
         dt = time.perf_counter() - t0
         rate = n_batches * batch / dt
-        print(json.dumps({
+        rec = {
             "metric": "input_pipeline_samples_per_sec",
             "value": round(rate, 1),
             "unit": "samples/s",
@@ -1080,7 +1105,9 @@ def bench_input() -> None:
             "batch": batch,
             "batches_timed": n_batches,
             "note": "host-only; must exceed device train samples/s",
-        }))
+        }
+        rec.update(_obs_extra())
+        print(json.dumps(rec))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -1159,6 +1186,7 @@ def bench_trainer() -> None:
                     "prefetcher cold start (amortized over `steps`)",
         }
         rec.update(info)
+        rec.update(_obs_extra())
         print(json.dumps(rec))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -13,11 +13,19 @@ export PYTHONPATH="$PWD"
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
+echo "== lint (ruff or compileall fallback)"
+bash scripts/lint.sh
+
+echo "== telemetry smoke (obs registry/spans/exporters)"
+python -m pytest tests/test_obs*.py -q -p no:cacheprovider
+
 echo "== test suite"
+# obs tests already ran in the smoke step above — skip the rerun
+OBS_SKIP=(--ignore=tests/test_obs.py --ignore=tests/test_obs_integration.py)
 if [ "${1:-fast}" = "full" ]; then
-  python -m pytest tests/ -q
+  python -m pytest tests/ -q "${OBS_SKIP[@]}"
 else
-  python -m pytest tests/ -q -m "not slow"
+  python -m pytest tests/ -q -m "not slow" "${OBS_SKIP[@]}"
 fi
 
 echo "== driver hooks: entry() trace + 8-device sharded dryrun"

@@ -1,12 +1,19 @@
 #!/usr/bin/env python
-"""Summarize a TS_PROFILE_DIR profiler capture into the op-level table
-BASELINE.md's arbitration asks for (top ops by device time, per lane).
+"""Summarize a trace capture into the op-level table BASELINE.md's
+arbitration asks for (top ops by device time, per lane).
 
     python scripts/trace_summary.py [exp/trace_r05] [--top 15] [--json]
+    python scripts/trace_summary.py logs/exp/train/events.jsonl
 
-Reads the Chrome-trace JSON (`*.trace.json.gz`) that `jax.profiler`
-writes next to the xplane file (TensorBoard not required — the rig has
-no tensorboard_plugin_profile, so this parses the portable format).
+Two capture kinds, one tool (ISSUE 1 satellite):
+
+  * Chrome-trace JSON (`*.trace.json[.gz]`) that `jax.profiler` writes
+    next to the xplane file (TensorBoard not required — the rig has no
+    tensorboard_plugin_profile, so this parses the portable format);
+  * the unified obs `events.jsonl` (obs/export.py EventSink +
+    SummaryWriter scalars in one file): `{"kind": "span", ...}` records
+    are treated as complete events; scalar/step records are skipped.
+
 Events are grouped into lanes (one per process/pid: TPU device lanes,
 host threads); within a lane, complete events ('ph': 'X') are summed by
 name.  Python host-frame events (names like `$threading.py:323 wait`)
@@ -14,8 +21,9 @@ are dropped from per-op tables by default — on a device lane the names
 are XLA ops/fusions, which is the table that names the bottleneck op
 (e.g. the transformer <6%-MFU escalation in BASELINE.md).
 
-The capture itself happens inside a tunnel window via
-scripts/capture_window_extras.sh; this summarizer runs offline.
+Directory arguments prefer profiler captures when both kinds are
+present (the established behavior); point at the events.jsonl file
+directly — or a directory holding only events.jsonl — for span tables.
 """
 
 from __future__ import annotations
@@ -30,15 +38,51 @@ from collections import defaultdict
 
 
 def find_trace_files(root: str) -> list:
+    """Candidate captures under `root`: profiler Chrome traces when any
+    exist (established behavior), else unified obs events.jsonl files."""
+    if os.path.isfile(root):
+        return [root]
     pats = [os.path.join(root, "**", "*.trace.json.gz"),
             os.path.join(root, "**", "*.trace.json")]
     files: list = []
     for p in pats:
         files.extend(glob.glob(p, recursive=True))
-    return sorted(files)
+    if files:
+        return sorted(files)
+    return sorted(glob.glob(os.path.join(root, "**", "events.jsonl"),
+                            recursive=True))
+
+
+def _events_jsonl_to_trace(path: str) -> dict:
+    """Unified events.jsonl -> the Chrome-trace dict shape summarize()
+    consumes.  Span records become 'X' complete events; SummaryWriter
+    scalar records ({"step": N, ...}) and snapshot dumps are skipped."""
+    events: list = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # half-written tail line of a live run
+            if not isinstance(rec, dict) or rec.get("kind") != "span":
+                continue
+            events.append({
+                "ph": "X",
+                "name": rec.get("name", "?"),
+                "ts": float(rec.get("ts_us", 0)),
+                "dur": float(rec.get("dur_us", 0)),
+                "pid": rec.get("pid", 0),
+                "tid": rec.get("tid", 0),
+            })
+    return {"traceEvents": events}
 
 
 def load_events(path: str) -> dict:
+    if path.endswith(".jsonl"):
+        return _events_jsonl_to_trace(path)
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rt") as f:
         return json.load(f)
@@ -111,9 +155,10 @@ def main(argv=None):
 
     files = find_trace_files(args.trace_dir)
     if not files:
-        print(f"no *.trace.json[.gz] under {args.trace_dir} — capture one "
-              f"in a tunnel window (scripts/capture_window_extras.sh)",
-              file=sys.stderr)
+        print(f"no *.trace.json[.gz] or events.jsonl under "
+              f"{args.trace_dir} — capture a profiler trace in a tunnel "
+              f"window (scripts/capture_window_extras.sh) or run with obs "
+              f"enabled (OBSERVABILITY.md)", file=sys.stderr)
         return 1
     path = files[-1]  # newest capture wins (sorted paths are dated)
     lanes = summarize(load_events(path), args.host_frames)

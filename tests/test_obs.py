@@ -1,0 +1,372 @@
+"""Unit tests for the obs/ observability layer: registry semantics,
+concurrency, histogram percentile math vs numpy, span nesting, and the
+exporter round trip through scripts/trace_summary.py (ISSUE 1
+satellite 3)."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.obs.registry import Registry
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import trace_summary  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_basics(self):
+        r = Registry()
+        c = r.counter("t/c")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = r.gauge("t/g")
+        g.set(7)
+        g.inc()
+        g.dec(3)
+        assert g.value == 5.0
+
+    def test_get_or_create_identity_and_type_conflict(self):
+        r = Registry()
+        assert r.counter("x") is r.counter("x")
+        with pytest.raises(TypeError):
+            r.gauge("x")
+
+    def test_threaded_counter_increments(self):
+        r = Registry()
+        c = r.counter("t/threads")
+        h = r.histogram("t/h", buckets=(1.0, 2.0, 3.0))
+        n_threads, n_iters = 8, 5000
+
+        def worker(i):
+            for k in range(n_iters):
+                c.inc()
+                h.observe((i + k) % 3 + 0.5)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.value == n_threads * n_iters
+        assert h.count == n_threads * n_iters
+        snap = h.snapshot()
+        assert sum(snap["counts"]) == h.count
+
+    def test_snapshot_and_compact(self):
+        r = Registry()
+        r.counter("a/used").inc(2)
+        r.counter("a/unused")
+        r.histogram("a/h").observe(0.5)
+        r.histogram("a/h_empty")
+        full = r.snapshot()
+        assert set(full) == {"a/used", "a/unused", "a/h", "a/h_empty"}
+        compact = r.snapshot(compact=True)
+        assert set(compact) == {"a/used", "a/h"}
+        assert compact["a/h"]["count"] == 1
+        assert compact["a/h"]["p50"] > 0
+
+    def test_disabled_registry_hands_out_shared_nulls(self):
+        """The near-zero-cost-when-disabled contract: every call site
+        gets the SAME null singletons, whose mutators are no-ops."""
+        r = Registry(enabled=False)
+        assert r.counter("x") is obs.NULL_COUNTER
+        assert r.gauge("x") is obs.NULL_GAUGE
+        assert r.histogram("x") is obs.NULL_HISTOGRAM
+        obs.NULL_COUNTER.inc(5)
+        assert obs.NULL_COUNTER.value == 0.0
+        obs.NULL_HISTOGRAM.observe(1.0)
+        assert obs.NULL_HISTOGRAM.percentile(50) == 0.0
+        # disabled spans are the shared null context manager
+        from textsummarization_on_flink_tpu.obs import spans as spans_lib
+
+        assert spans_lib.span(r, "anything") is obs.NULL_SPAN
+
+    def test_ts_obs_env_gate(self, monkeypatch):
+        monkeypatch.setenv("TS_OBS", "0")
+        assert not obs.enabled_from_env()
+        monkeypatch.setenv("TS_OBS", "1")
+        assert obs.enabled_from_env()
+        monkeypatch.delenv("TS_OBS")
+        assert obs.enabled_from_env()
+
+    def test_registry_for_hparams_gate(self):
+        from textsummarization_on_flink_tpu.config import HParams
+
+        with obs.use_registry(Registry()):
+            assert obs.registry_for(HParams(obs=False)) is obs.NULL_REGISTRY
+            assert obs.registry_for(HParams(obs=True)) is obs.registry()
+            assert obs.registry_for(None) is obs.registry()
+
+
+# --------------------------------------------------------------------------
+# histogram percentiles vs numpy
+# --------------------------------------------------------------------------
+
+class TestHistogramPercentiles:
+    def test_uniform_against_numpy(self):
+        r = Registry()
+        h = r.histogram("t/u", buckets=tuple(np.linspace(0.01, 1.0, 100)))
+        rng = np.random.RandomState(0)
+        vals = rng.uniform(0, 1, 4000)
+        for v in vals:
+            h.observe(float(v))
+        for q in (10, 50, 90, 99):
+            got = h.percentile(q)
+            want = float(np.percentile(vals, q))
+            # bucket width is 0.01; interpolation keeps us within ~2 widths
+            assert abs(got - want) < 0.025, (q, got, want)
+        assert h.count == len(vals)
+        assert h.sum == pytest.approx(float(vals.sum()), rel=1e-6)
+        assert h.mean == pytest.approx(float(vals.mean()), rel=1e-6)
+
+    def test_lognormal_against_numpy_with_exponential_buckets(self):
+        r = Registry()
+        h = r.histogram(
+            "t/ln", buckets=obs.exponential_buckets(1e-4, 1.3, 60))
+        rng = np.random.RandomState(1)
+        vals = np.exp(rng.normal(-4.0, 1.0, 3000))
+        for v in vals:
+            h.observe(float(v))
+        for q in (50, 90, 99):
+            got = h.percentile(q)
+            want = float(np.percentile(vals, q))
+            # exponential buckets: error bounded by the bucket RATIO
+            assert want / 1.35 <= got <= want * 1.35, (q, got, want)
+
+    def test_overflow_bucket_and_edge_quantiles(self):
+        r = Registry()
+        h = r.histogram("t/o", buckets=(1.0, 2.0))
+        for v in (0.5, 1.5, 100.0):
+            h.observe(v)
+        assert h.snapshot()["counts"] == [1, 1, 1]
+        assert h.percentile(100) == pytest.approx(100.0)
+        assert h.percentile(0) <= 0.5
+        with pytest.raises(ValueError):
+            h.percentile(101)
+
+    def test_empty_histogram(self):
+        h = Registry().histogram("t/e")
+        assert h.percentile(50) == 0.0
+        assert h.count == 0
+
+    def test_bad_buckets_rejected(self):
+        r = Registry()
+        with pytest.raises(ValueError):
+            r.histogram("t/bad", buckets=(2.0, 1.0))
+        with pytest.raises(ValueError):
+            obs.exponential_buckets(0.0, 2.0, 3)
+
+
+# --------------------------------------------------------------------------
+# spans
+# --------------------------------------------------------------------------
+
+class TestSpans:
+    def test_nesting_order_and_parent(self):
+        with obs.use_registry(Registry()):
+            with obs.span("outer"):
+                time.sleep(0.002)
+                with obs.span("inner", step=3):
+                    time.sleep(0.002)
+            spans = obs.tracer_for(obs.registry()).finished()
+        # inner finishes first (recorded in completion order)
+        assert [s.name for s in spans] == ["inner", "outer"]
+        inner, outer = spans
+        assert inner.parent == "outer" and inner.depth == 1
+        assert outer.parent is None and outer.depth == 0
+        assert inner.attrs == {"step": 3}
+        # nested span's duration is contained in the parent's
+        assert 0 < inner.duration <= outer.duration
+        assert outer.wall_start <= inner.wall_start
+
+    def test_span_survives_exception(self):
+        with obs.use_registry(Registry()):
+            with pytest.raises(RuntimeError):
+                with obs.span("boom"):
+                    raise RuntimeError("x")
+            spans = obs.tracer_for(obs.registry()).finished()
+            assert [s.name for s in spans] == ["boom"]
+            # the stack unwound: a following span is top-level again
+            with obs.span("after"):
+                pass
+            assert obs.tracer_for(obs.registry()).finished()[-1].depth == 0
+
+    def test_ring_buffer_bounds_and_drop_counter(self):
+        from textsummarization_on_flink_tpu.obs.spans import Tracer
+
+        reg = Registry()
+        tracer = Tracer(reg, max_spans=10)
+        reg.tracer = tracer
+        for i in range(25):
+            with tracer.span(f"s{i}"):
+                pass
+        assert len(tracer.finished()) == 10
+        assert reg.counter("obs/spans_dropped_total").value == 15
+        # oldest dropped, newest retained
+        assert tracer.finished()[-1].name == "s24"
+
+    def test_chrome_trace_events_shape(self):
+        with obs.use_registry(Registry()):
+            with obs.span("a/b"):
+                pass
+            events = obs.tracer_for(obs.registry()).chrome_trace_events()
+        metas = [e for e in events if e["ph"] == "M"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert any(e["name"] == "process_name" for e in metas)
+        assert any(e["name"] == "thread_name" for e in metas)
+        assert len(xs) == 1 and xs[0]["name"] == "a/b"
+        assert xs[0]["dur"] >= 0 and xs[0]["ts"] > 0
+
+
+# --------------------------------------------------------------------------
+# render_text (Prometheus-style exposition)
+# --------------------------------------------------------------------------
+
+class TestRenderText:
+    def test_exposition_format(self):
+        r = Registry()
+        r.counter("train/steps_total").inc(5)
+        r.gauge("train/prefetch_queue_depth").set(2)
+        h = r.histogram("decode/request_latency_seconds",
+                        buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = r.render_text()
+        assert "# TYPE train_steps_total counter" in text
+        assert "train_steps_total 5" in text
+        assert "train_prefetch_queue_depth 2" in text
+        assert ('decode_request_latency_seconds_bucket{le="0.1"} 1'
+                in text)
+        assert ('decode_request_latency_seconds_bucket{le="+Inf"} 2'
+                in text)
+        assert "decode_request_latency_seconds_count 2" in text
+
+    def test_empty_registry_renders_empty(self):
+        assert Registry().render_text() == ""
+
+
+# --------------------------------------------------------------------------
+# exporters
+# --------------------------------------------------------------------------
+
+class TestEventSink:
+    def test_jsonl_round_trip(self, tmp_path):
+        with obs.use_registry(Registry()):
+            sink = obs.install_event_sink(str(tmp_path), flush_secs=0.05)
+            with obs.span("train/step"):
+                time.sleep(0.001)
+            sink.emit({"kind": "snapshot", "metrics": {}})
+            sink.close()
+            recs = [json.loads(ln) for ln in
+                    open(tmp_path / "events.jsonl", encoding="utf-8")]
+        kinds = [r["kind"] for r in recs]
+        assert "span" in kinds and "snapshot" in kinds
+        span_rec = next(r for r in recs if r["kind"] == "span")
+        assert span_rec["name"] == "train/step"
+        assert span_rec["dur_us"] >= 1000
+
+    def test_bounded_queue_drops_and_counts(self, tmp_path):
+        from textsummarization_on_flink_tpu.obs.export import EventSink
+
+        reg = Registry()
+        sink = EventSink(str(tmp_path), flush_secs=30.0, max_queue=4,
+                         registry=reg)
+        # flusher sleeps 30s between drains: overfill deterministically
+        sent = [sink.emit({"kind": "span", "i": i}) for i in range(10)]
+        assert sum(sent) <= 4
+        assert reg.counter("obs/events_dropped_total").value >= 6
+        sink.close()
+
+    def test_sink_survives_rotated_directory(self, tmp_path):
+        import shutil
+
+        from textsummarization_on_flink_tpu.obs.export import EventSink
+
+        reg = Registry()
+        d = tmp_path / "logs"
+        sink = EventSink(str(d), flush_secs=0.05, registry=reg)
+        sink.emit({"kind": "span", "name": "a"})
+        sink.flush()
+        shutil.rmtree(d)  # rotate the log dir out from under the sink
+        sink.emit({"kind": "span", "name": "b"})
+        sink.flush()
+        sink.close()
+        # the sink recreated the directory and kept writing
+        recs = [json.loads(ln)
+                for ln in open(d / "events.jsonl", encoding="utf-8")]
+        assert [r["name"] for r in recs] == ["b"]
+        assert reg.counter("obs/sink_write_errors_total").value == 0
+
+    def test_disabled_registry_install_is_noop(self, tmp_path):
+        reg = Registry(enabled=False)
+        from textsummarization_on_flink_tpu.obs.export import (
+            install_event_sink,
+        )
+
+        assert install_event_sink(reg, str(tmp_path)) is None
+        assert not (tmp_path / "events.jsonl").exists()
+
+
+class TestTraceSummaryRoundTrip:
+    """One tool, both capture kinds (ISSUE 1 satellite: events.jsonl)."""
+
+    def test_chrome_trace_export_summarized(self, tmp_path, capsys):
+        with obs.use_registry(Registry()):
+            for _ in range(3):
+                with obs.span("decode/batch"):
+                    time.sleep(0.001)
+            path = str(tmp_path / "cap" / "obs.trace.json")
+            n = obs.write_chrome_trace(path)
+        assert n == 3
+        rc = trace_summary.main([str(tmp_path / "cap"), "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        ops = {o["name"]: o for lane in out["lanes"] for o in lane["ops"]}
+        assert ops["decode/batch"]["count"] == 3
+        assert ops["decode/batch"]["total_us"] >= 3000
+
+    def test_events_jsonl_summarized(self, tmp_path, capsys):
+        with obs.use_registry(Registry()):
+            sink = obs.install_event_sink(str(tmp_path), flush_secs=0.05)
+            for _ in range(2):
+                with obs.span("train/metrics_flush"):
+                    time.sleep(0.001)
+            sink.close()
+        # SummaryWriter-style scalar lines share the file and are skipped
+        with open(tmp_path / "events.jsonl", "a", encoding="utf-8") as f:
+            f.write(json.dumps({"step": 1, "loss": 2.5}) + "\n")
+        rc = trace_summary.main([str(tmp_path), "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["trace"].endswith("events.jsonl")
+        ops = {o["name"]: o for lane in out["lanes"] for o in lane["ops"]}
+        assert ops["train/metrics_flush"]["count"] == 2
+
+    def test_profiler_trace_preferred_over_events(self, tmp_path):
+        (tmp_path / "events.jsonl").write_text("")
+        (tmp_path / "x.trace.json").write_text('{"traceEvents": []}')
+        files = trace_summary.find_trace_files(str(tmp_path))
+        assert files == [str(tmp_path / "x.trace.json")]
+
+    def test_direct_file_argument(self, tmp_path):
+        p = tmp_path / "events.jsonl"
+        p.write_text(json.dumps({"kind": "span", "name": "a", "ts_us": 1,
+                                 "dur_us": 5, "pid": 1, "tid": 1}) + "\n"
+                     + "{half-written")
+        assert trace_summary.find_trace_files(str(p)) == [str(p)]
+        trace = trace_summary.load_events(str(p))
+        assert len(trace["traceEvents"]) == 1  # bad tail line skipped

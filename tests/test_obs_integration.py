@@ -1,0 +1,283 @@
+"""End-to-end observability assertions (ISSUE 1 acceptance criteria):
+a 50-step CPU training run populates the step-time histogram, the
+prefetcher queue-depth gauge, and the examples counter; a decode of one
+batch populates the per-request latency histogram; the PrefetchError
+and SummaryWriter-rotation satellites behave as specified."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.batcher import Batcher
+from textsummarization_on_flink_tpu.data.batching import Batch, SummaryExample
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.decode import decoder as dec_lib
+from textsummarization_on_flink_tpu.obs.registry import Registry
+from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+from textsummarization_on_flink_tpu.train.trainer import (
+    DevicePrefetcher,
+    PrefetchError,
+    SummaryWriter,
+    Trainer,
+)
+
+WORDS = ("the a cat dog sat ran mat home big small quick brown fox jumped "
+         "over lazy it was day night").split()
+
+
+def hps_tiny(**kw):
+    base = dict(batch_size=2, max_enc_steps=8, max_dec_steps=5,
+                min_dec_steps=1, hidden_dim=4, emb_dim=3, max_oov_buckets=2,
+                vocab_size=0, beam_size=2)
+    base.update(kw)
+    return HParams(**base)
+
+
+@pytest.fixture
+def vocab():
+    return Vocab(words=WORDS)
+
+
+def make_source(n):
+    def src():
+        return iter([(f"the quick brown fox {WORDS[i % len(WORDS)]} .",
+                      f"<s> the fox {WORDS[i % len(WORDS)]} . </s>")
+                     for i in range(n)])
+    return src
+
+
+class TestTrainRunTelemetry:
+    def test_50_step_run_populates_registry(self, tmp_path, vocab):
+        """The acceptance-criteria run: 50 steps on CPU through the REAL
+        threaded Batcher + DevicePrefetcher, then render_text() must
+        show a non-zero step-time histogram, the prefetcher queue-depth
+        gauge, and the examples counters."""
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="t", num_steps=50)
+        with obs.use_registry(Registry()):
+            batcher = Batcher("", vocab, hps, single_pass=True,
+                              example_source=make_source(120))
+            trainer = Trainer(hps, vocab.size(), batcher)
+            state = trainer.train(num_steps=50)
+            assert int(np.asarray(state.step)) == 50
+            reg = obs.registry()
+            text = reg.render_text()
+
+        # step-time histogram: one sample per step, all positive
+        h = reg.get("train/step_time_seconds")
+        assert h.count == 50
+        assert h.sum > 0 and h.percentile(50) > 0
+        # steps/examples counters (examples/sec = counter over wall time)
+        assert reg.get("train/steps_total").value == 50
+        assert reg.get("train/examples_total").value == 50 * hps.batch_size
+        assert reg.get("data/examples_total").value >= 100
+        # prefetcher telemetry: the gauge was written, pulls were counted
+        assert reg.get("train/prefetch_queue_depth") is not None
+        assert reg.get("train/prefetch_batches_total").value >= 50
+        # the host-wait and metrics-fetch histograms saw every window
+        assert reg.get("train/host_wait_seconds").count >= 50
+        assert reg.get("train/metrics_fetch_seconds").count >= 1
+        # text exposition carries all of it
+        assert "train_step_time_seconds_count 50" in text
+        assert "train_prefetch_queue_depth" in text
+        assert "train_examples_total 100" in text
+
+    def test_disabled_run_records_nothing(self, tmp_path, vocab):
+        """TS_OBS=0-equivalent: hps.obs=False routes the whole job
+        through the null registry — zero metrics, same training result
+        (the <2%-overhead claim is structural: disabled call sites hold
+        shared null singletons; see test_obs.py null-identity tests)."""
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="d", obs=False)
+        with obs.use_registry(Registry()):
+            batcher = Batcher("", vocab, hps, single_pass=True,
+                              example_source=make_source(30))
+            trainer = Trainer(hps, vocab.size(), batcher)
+            assert trainer._m_step_time is obs.NULL_HISTOGRAM
+            state = trainer.train(num_steps=5)
+            assert int(np.asarray(state.step)) == 5
+            assert obs.registry().snapshot(compact=True) == {}
+
+    def test_ts_obs_events_streams_spans_to_events_jsonl(self, tmp_path,
+                                                         vocab, monkeypatch):
+        """TS_OBS_EVENTS=1: span records share the scalar summaries'
+        events.jsonl (the unified format one trace_summary.py reads)."""
+        monkeypatch.setenv("TS_OBS_EVENTS", "1")
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="ev")
+        with obs.use_registry(Registry()):
+            batcher = Batcher("", vocab, hps, single_pass=True,
+                              example_source=make_source(30))
+            trainer = Trainer(hps, vocab.size(), batcher)
+            trainer.train(num_steps=4)
+            trainer.writer.close()
+            sink = obs.registry().event_sink
+            assert sink is not None
+            sink.close()
+        events = os.path.join(str(tmp_path), "ev", "train", "events.jsonl")
+        recs = [json.loads(ln) for ln in open(events, encoding="utf-8")]
+        kinds = {r.get("kind", "scalar") for r in recs}
+        assert "scalar" in kinds and "span" in kinds
+        span_names = {r["name"] for r in recs if r.get("kind") == "span"}
+        assert "train/metrics_flush" in span_names
+
+    def test_summary_scalars_unaffected_by_obs(self, tmp_path, vocab):
+        """The JSONL summaries keep one record per step either way."""
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="s",
+                       summary_flush_every=4)
+        with obs.use_registry(Registry()):
+            batcher = Batcher("", vocab, hps, single_pass=True,
+                              example_source=make_source(30))
+            trainer = Trainer(hps, vocab.size(), batcher)
+            trainer.train(num_steps=6)
+            trainer.writer.close()
+        events = os.path.join(str(tmp_path), "s", "train", "events.jsonl")
+        recs = [json.loads(ln) for ln in open(events, encoding="utf-8")]
+        assert [r["step"] for r in recs] == list(range(1, 7))
+
+
+class TestDecodeTelemetry:
+    def test_one_batch_decode_populates_latency_histogram(self, vocab,
+                                                          tmp_path):
+        hps = hps_tiny(mode="decode")
+        with obs.use_registry(Registry()):
+            state = trainer_lib.init_train_state(hps, vocab.size(), seed=0)
+            d = dec_lib.BeamSearchDecoder(hps, vocab, batcher=None,
+                                          params=state.params,
+                                          decode_root=str(tmp_path))
+            exs = [SummaryExample.build(
+                f"the quick brown fox {w} .", ["the fox ."], vocab, hps)
+                for w in ("sat", "ran")]
+            batch = Batch(exs, hps, vocab)
+            results = d.decode_batch(batch)
+            reg = obs.registry()
+        assert len(results) == 2
+        h = reg.get("decode/request_latency_seconds")
+        assert h.count == 2 and h.percentile(50) > 0
+        assert reg.get("decode/requests_total").value == 2
+        assert reg.get("decode/tokens_total").value >= 0
+        assert reg.get("decode/busy_seconds_total").value > 0
+        # the dispatch went through run_beam_search: its first call is a
+        # compile-cache miss, and the span was recorded
+        misses = reg.get("decode/compile_cache_misses_total")
+        assert misses is not None and misses.value >= 1
+        names = [s.name for s in obs.tracer_for(reg).finished()]
+        assert "decode/batch" in names
+
+    def test_compile_cache_hit_on_second_batch(self, vocab, tmp_path):
+        hps = hps_tiny(mode="decode")
+        with obs.use_registry(Registry()):
+            state = trainer_lib.init_train_state(hps, vocab.size(), seed=0)
+            d = dec_lib.BeamSearchDecoder(hps, vocab, batcher=None,
+                                          params=state.params,
+                                          decode_root=str(tmp_path))
+            exs = [SummaryExample.build(
+                f"the quick brown fox {w} .", ["the fox ."], vocab, hps)
+                for w in ("sat", "ran")]
+            d.decode_batch(Batch(exs, hps, vocab))
+            d.decode_batch(Batch(exs, hps, vocab))
+            hits = obs.registry().get("decode/compile_cache_hits_total")
+        # same shapes/config: the second dispatch reuses the executable
+        assert hits is not None and hits.value >= 1
+
+
+class TestPrefetchErrorSatellite:
+    class _FailingBatcher:
+        def __init__(self, n_good=0):
+            self.n_good = n_good
+
+        def next_batch(self):
+            if self.n_good > 0:
+                self.n_good -= 1
+                return object()
+            raise IOError("disk gone")
+
+    def test_worker_failure_surfaces_as_typed_error(self):
+        with obs.use_registry(Registry()):
+            p = DevicePrefetcher(self._FailingBatcher(), transfer=lambda a: a)
+            with pytest.raises(PrefetchError) as ei:
+                p.next_batch()
+            p.stop()
+            assert isinstance(ei.value.__cause__, IOError)
+            # the failure path feeds the error counter
+            assert obs.registry().get(
+                "train/prefetch_errors_total").value == 1
+
+    def test_prefetch_error_is_runtime_error(self):
+        # pre-existing handlers catch RuntimeError; the typed error must
+        # keep flowing through them
+        assert issubclass(PrefetchError, RuntimeError)
+
+    def test_trainer_loop_surfaces_prefetch_error(self, tmp_path, vocab):
+        class Boom:
+            def next_batch(self):
+                raise ValueError("stream corrupted")
+
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="x")
+        with obs.use_registry(Registry()):
+            trainer = Trainer(hps, vocab.size(), Boom())
+            with pytest.raises(PrefetchError):
+                trainer.train(num_steps=3)
+
+    def test_transfer_failure_also_typed(self):
+        class OneBatch:
+            def __init__(self):
+                self.sent = False
+
+            def next_batch(self):
+                if self.sent:
+                    return None
+                self.sent = True
+
+                class B:
+                    def as_arrays(self):
+                        return {}
+                return B()
+
+        def bad_transfer(arrays):
+            raise RuntimeError("H2D failed")
+
+        with obs.use_registry(Registry()):
+            p = DevicePrefetcher(OneBatch(), transfer=bad_transfer)
+            with pytest.raises(PrefetchError):
+                p.next_batch()
+            p.stop()
+
+
+class TestSummaryWriterSatellite:
+    def test_rotated_directory_does_not_crash(self, tmp_path):
+        reg = Registry()
+        d = str(tmp_path / "train")
+        w = SummaryWriter(d, flush_every=1, registry=reg)
+        w.scalars(1, loss=1.0)
+        shutil.rmtree(d)  # rotate the whole job dir away mid-run
+        w.scalars(2, loss=0.9)  # must not raise
+        w.scalars(3, loss=0.8)
+        w.close()
+        recs = [json.loads(ln) for ln in
+                open(os.path.join(d, "events.jsonl"), encoding="utf-8")]
+        assert [r["step"] for r in recs] == [2, 3]
+        assert reg.counter("train/summary_write_errors").value == 0
+
+    def test_unwritable_directory_counts_errors(self, tmp_path):
+        reg = Registry()
+        blocker = tmp_path / "file"
+        blocker.write_text("")
+        # directory path occupied by a FILE: open/makedirs keeps failing
+        w = SummaryWriter(str(blocker / "sub"), registry=reg)
+        w.scalars(1, loss=1.0)
+        w.scalars(2, loss=0.5)
+        assert reg.counter("train/summary_write_errors").value == 2
+
+    def test_flush_cadence_buffers_writes(self, tmp_path):
+        d = str(tmp_path / "t")
+        w = SummaryWriter(d, flush_every=1000, registry=Registry())
+        w.scalars(1, loss=1.0)
+        path = os.path.join(d, "events.jsonl")
+        # buffered, not yet flushed (small payload < libc buffer)
+        assert os.path.getsize(path) == 0
+        w.flush()
+        assert os.path.getsize(path) > 0
+        w.close()

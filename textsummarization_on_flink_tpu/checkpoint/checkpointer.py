@@ -34,6 +34,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.train import optim
 from textsummarization_on_flink_tpu.train.trainer import TrainState
@@ -232,16 +233,27 @@ class Checkpointer:
         filesystem."""
         from textsummarization_on_flink_tpu.parallel import distributed
 
-        flat = state_to_arrays(state)  # collective on multi-host
-        step = int(np.asarray(flat.get("step", 0)))
-        path = os.path.join(self.directory, f"{CKPT_PREFIX}-{step}.npz")
-        if not distributed.is_chief():
-            return path
-        if self._sidecar_pending:
-            self._write_sidecar()
-        save_arrays(path, flat)
-        _write_index(self.directory, path, INDEX_FILE)
-        self._retain()
+        reg = obs.registry_for(self.hps)
+        t0 = time.perf_counter()
+        with obs.spans.span(reg, "checkpoint/save"):
+            flat = state_to_arrays(state)  # collective on multi-host
+            step = int(np.asarray(flat.get("step", 0)))
+            path = os.path.join(self.directory, f"{CKPT_PREFIX}-{step}.npz")
+            if not distributed.is_chief():
+                return path
+            if self._sidecar_pending:
+                self._write_sidecar()
+            save_arrays(path, flat)
+            _write_index(self.directory, path, INDEX_FILE)
+            self._retain()
+        reg.histogram("checkpoint/save_seconds").observe(
+            time.perf_counter() - t0)
+        reg.counter("checkpoint/saves_total").inc()
+        try:
+            reg.counter("checkpoint/save_bytes_total").inc(
+                os.path.getsize(path))
+        except OSError:  # pragma: no cover - raced with retention/cleanup
+            pass
         log.info("saved checkpoint %s", path)
         return path
 
@@ -260,7 +272,19 @@ class Checkpointer:
         path = path or latest_checkpoint(self.directory)
         if path is None:
             return None
-        return arrays_to_state(load_arrays(path))
+        reg = obs.registry_for(self.hps)
+        t0 = time.perf_counter()
+        with obs.spans.span(reg, "checkpoint/restore"):
+            state = arrays_to_state(load_arrays(path))
+        reg.histogram("checkpoint/restore_seconds").observe(
+            time.perf_counter() - t0)
+        reg.counter("checkpoint/restores_total").inc()
+        try:
+            reg.counter("checkpoint/restore_bytes_total").inc(
+                os.path.getsize(path))
+        except OSError:  # pragma: no cover
+            pass
+        return state
 
 
 class BestModelSaver:

@@ -109,6 +109,15 @@ class HParams:
     # itself.  Numerically identical at any value; raises compile time
     # with k.  1 = no unrolling.
     scan_unroll: int = 8
+    # runtime observability (obs/ registry + spans + exporters,
+    # OBSERVABILITY.md): False runs this job dark — obs.registry_for(hps)
+    # hands the component null metrics.  The process-wide kill switch is
+    # TS_OBS=0 (read once, at default-registry creation).
+    obs: bool = True
+    # SummaryWriter flush cadence in records: 1 flushes every write
+    # (historical behavior), k>1 buffers k records per flush (the
+    # reference flushes every 100 steps, run_summarization.py:242-244)
+    summary_flush_every: int = 1
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score
@@ -246,6 +255,9 @@ class HParams:
         if self.steps_per_dispatch < 1:
             raise ValueError(f"steps_per_dispatch must be >= 1, got "
                              f"{self.steps_per_dispatch}")
+        if self.summary_flush_every < 1:
+            raise ValueError(f"summary_flush_every must be >= 1, got "
+                             f"{self.summary_flush_every}")
 
 
 def beam_chunk_from_env() -> int:

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional, Tuple
 
+from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.data import chunks, oov as oov_lib
 from textsummarization_on_flink_tpu.data.batching import Batch, SummaryExample
@@ -79,6 +80,18 @@ class Batcher:
         self._fill_error: Optional[BaseException] = None
         self._fill_error_lock = threading.Lock()
 
+        # observability (`data/` namespace, OBSERVABILITY.md): examples
+        # built, OOV volume (rate = oov_words / enc_tokens), empty-article
+        # skips, batches emitted, and output-queue fill — examples/sec is
+        # the counter's derivative, which the exporter snapshot carries
+        reg = obs.registry_for(hps)
+        self._c_examples = reg.counter("data/examples_total")
+        self._c_empty = reg.counter("data/empty_articles_total")
+        self._c_batches = reg.counter("data/batches_total")
+        self._c_oov_words = reg.counter("data/oov_words_total")
+        self._c_enc_tokens = reg.counter("data/enc_tokens_total")
+        self._g_fill = reg.gauge("data/batch_queue_depth")
+
         self._example_q_threads = []
         for _ in range(self._num_example_q_threads):
             t = threading.Thread(target=self._run_producer,
@@ -123,7 +136,9 @@ class Batcher:
         warned = False
         while True:
             try:
-                return self._batch_queue.get(timeout=0.2)
+                batch = self._batch_queue.get(timeout=0.2)
+                self._g_fill.set(self._batch_queue.qsize())
+                return batch
             except queue.Empty:
                 self.raise_if_failed()
                 if not warned:
@@ -161,6 +176,7 @@ class Batcher:
             article = e.get_str("article")
             abstract = e.get_str("abstract")
             if len(article) == 0:
+                self._c_empty.inc()
                 log.warning("Found an example with empty article text. Skipping it.")
                 continue
             yield article, abstract
@@ -187,6 +203,9 @@ class Batcher:
                 s.strip() for s in oov_lib.abstract2sents(abstract)]
             ex = SummaryExample.build(article, abstract_sentences, self._vocab,
                                       self._hps, uuid=uuid, reference=reference)
+            self._c_examples.inc()
+            self._c_enc_tokens.inc(ex.enc_len)
+            self._c_oov_words.inc(len(ex.article_oovs))
             self._example_queue.put(ex)
 
     def _get_example(self, timeout: Optional[float] = None) -> Optional[SummaryExample]:
@@ -206,6 +225,11 @@ class Batcher:
                 waited += 0.2
                 if timeout is not None and waited >= timeout:
                     return None
+
+    def _put_batch(self, batch: Batch) -> None:
+        self._batch_queue.put(batch)
+        self._c_batches.inc()
+        self._g_fill.set(self._batch_queue.qsize())
 
     def _fill_batch_queue(self) -> None:
         hps = self._hps
@@ -233,7 +257,7 @@ class Batcher:
                 if not self._single_pass:
                     random.shuffle(batches)
                 for b in batches:
-                    self._batch_queue.put(Batch(
+                    self._put_batch(Batch(
                         [r[0] for r in b], hps, self._vocab,
                         real_mask=[r[1] for r in b]))
             elif self._decode_batch_mode == "repeat":
@@ -242,8 +266,7 @@ class Batcher:
                     break
                 b = [ex] * hps.batch_size
                 mask = [True] + [False] * (hps.batch_size - 1)
-                self._batch_queue.put(Batch(b, hps, self._vocab,
-                                            real_mask=mask))
+                self._put_batch(Batch(b, hps, self._vocab, real_mask=mask))
             else:  # 'distinct': fill a whole batch of different articles
                 exs = []
                 first = self._get_example()  # wait for the first article
@@ -262,8 +285,7 @@ class Batcher:
                 while len(exs) < hps.batch_size:
                     exs.append(exs[-1])
                 mask = [i < n_real for i in range(hps.batch_size)]
-                self._batch_queue.put(Batch(exs, hps, self._vocab,
-                                            real_mask=mask))
+                self._put_batch(Batch(exs, hps, self._vocab, real_mask=mask))
 
     def _watch_threads(self) -> None:
         while True:

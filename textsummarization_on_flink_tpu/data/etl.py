@@ -29,6 +29,7 @@ import os
 import re
 from typing import Iterable, List, Optional, Tuple
 
+from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.data import chunks
 from textsummarization_on_flink_tpu.data.tfexample import Example
 from textsummarization_on_flink_tpu.data.vocab import SENTENCE_END, SENTENCE_START
@@ -149,12 +150,17 @@ def write_to_bin(story_paths: List[str], out_prefix: str,
     train split is ~287k stories, far too large to hold as Examples).
     """
 
+    c_stories = obs.counter("etl/stories_total")
+    c_tokens = obs.counter("etl/tokens_total")
+
     def examples():
         for path in story_paths:
             with open(path, "r", encoding="utf-8") as f:
                 ex = story_to_example(f.read(), tokenize=tokenize)
+            art = ex.get_str("article")
+            c_stories.inc()
+            c_tokens.inc(art.count(" ") + 1)
             if makevocab and vocab_counter is not None:
-                art = ex.get_str("article")
                 abs_ = ex.get_str("abstract")
                 tokens = art.split() + [
                     t for t in abs_.split()
@@ -163,17 +169,21 @@ def write_to_bin(story_paths: List[str], out_prefix: str,
             yield ex
 
     n_chunks = max((len(story_paths) + chunk_size - 1) // chunk_size, 1)
-    return chunks.write_chunked_iter(out_prefix, examples(),
-                                     chunk_size=chunk_size,
-                                     total_chunks=n_chunks)
+    with obs.span("etl/write_to_bin", prefix=os.path.basename(out_prefix)):
+        return chunks.write_chunked_iter(out_prefix, examples(),
+                                         chunk_size=chunk_size,
+                                         total_chunks=n_chunks)
 
 
 def write_vocab(counter: collections.Counter, path: str,
                 size: int = VOCAB_SIZE) -> None:
     """`<word> <count>` lines, most common first (:199-203)."""
     with open(path, "w", encoding="utf-8") as f:
+        n = 0
         for word, count in counter.most_common(size):
             f.write(f"{word} {count}\n")
+            n += 1
+    obs.gauge("etl/vocab_words").set(n)
     log.info("Finished writing vocab file %s", path)
 
 

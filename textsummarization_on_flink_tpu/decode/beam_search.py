@@ -347,6 +347,20 @@ def run_beam_search(params, hps: HParams, arrays: Dict[str, np.ndarray],
     ids back to words (decode/decoder.py, mirroring decode.py:109-119).
     """
     loop = _loop_kind()
+    try:  # jit-cache growth across this call = a fresh trace/compile
+        before = run_beam_search_jit._cache_size()
+    except Exception:  # private API; telemetry must never break decode
+        before = None
     out = run_beam_search_jit(params, hps, arrays, loop=loop,
                               chunk=resolved_chunk(loop))
+    if before is not None:
+        try:
+            from textsummarization_on_flink_tpu import obs
+
+            missed = run_beam_search_jit._cache_size() > before
+            obs.registry_for(hps).counter(
+                "decode/compile_cache_misses_total" if missed
+                else "decode/compile_cache_hits_total").inc()
+        except Exception:
+            pass
     return BeamSearchOutput(*[np.asarray(x) for x in out])

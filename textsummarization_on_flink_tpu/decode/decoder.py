@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.data import oov as oov_lib
@@ -126,6 +127,17 @@ class BeamSearchDecoder:
         self._train_dir = train_dir
         self._max_ckpt_retries = max_ckpt_retries
         self._ckpt_path: Optional[str] = None
+        # observability (`decode/` namespace, OBSERVABILITY.md):
+        # per-request latency percentiles, finished beams, token volume
+        # (tokens/sec = decode/tokens_total over decode/busy_seconds_total),
+        # and continuous-mode checkpoint reloads
+        self._obs = obs.registry_for(hps)
+        self._m_latency = self._obs.histogram("decode/request_latency_seconds")
+        self._c_requests = self._obs.counter("decode/requests_total")
+        self._c_beams = self._obs.counter("decode/beams_finished_total")
+        self._c_tokens = self._obs.counter("decode/tokens_total")
+        self._c_busy = self._obs.counter("decode/busy_seconds_total")
+        self._c_reloads = self._obs.counter("decode/ckpt_reloads_total")
         self._params = params
         if params is None:
             self._load_params()
@@ -179,6 +191,7 @@ class BeamSearchDecoder:
             log.info("Decoder has been decoding for %.0f seconds; loading "
                      "new checkpoint", time.time() - last_load)
             self._load_params()
+            self._c_reloads.inc()
         return time.time()
 
     # -- decoding --
@@ -189,6 +202,21 @@ class BeamSearchDecoder:
         trickle/tail padding — are tagged by the batcher and dropped here;
         two legitimately identical input rows each get a result, matching
         the reference's one-result-per-record contract (decode.py:159-185)."""
+        t0 = time.perf_counter()
+        with obs.spans.span(self._obs, "decode/batch"):
+            results = self._decode_batch_inner(batch)
+        dt = time.perf_counter() - t0
+        self._c_busy.inc(dt)
+        # requests in a batch share one dispatch: the batch wall time IS
+        # each request's observed latency
+        for res in results:
+            self._m_latency.observe(dt)
+            self._c_tokens.inc(len(res.decoded_words))
+        self._c_requests.inc(len(results))
+        self._c_beams.inc(len(results))
+        return results
+
+    def _decode_batch_inner(self, batch: Batch) -> List[DecodedResult]:
         if self._sharded_search is not None:
             from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
 

@@ -1,0 +1,167 @@
+"""Unified runtime observability layer (ISSUE 1 tentpole).
+
+One process-wide registry of counters/gauges/histograms, lightweight
+nesting span tracing, and exporters (unified JSONL events, Chrome-trace
+dump, Prometheus-style text exposition).  Every layer of the stack —
+train loop, data pipeline, beam decoder, streaming pipeline, checkpoint
+IO — reports through this module; see OBSERVABILITY.md for the metric
+naming scheme (``<layer>/<name>``) and the full inventory.
+
+Usage:
+
+    from textsummarization_on_flink_tpu import obs
+
+    obs.counter("decode/tokens_total").inc(n)
+    obs.gauge("train/prefetch_queue_depth").set(q.qsize())
+    obs.histogram("decode/request_latency_seconds").observe(dt)
+    with obs.span("decode/batch"):
+        ...
+    print(obs.render_text())          # Prometheus-style exposition
+    obs.snapshot(compact=True)        # dict dump (BENCH row embedding)
+
+Disabling: ``TS_OBS=0`` in the environment kills the default registry
+for the whole process (instrumented code receives shared null metrics
+— near-zero cost); per-job, ``HParams(obs=False)`` makes
+``registry_for(hps)`` hand back the null registry so one component can
+run dark while others report.  Dependency-light by design: importing
+this package never imports jax/numpy.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional, Sequence
+
+from textsummarization_on_flink_tpu.obs.export import (
+    EventSink,
+    install_event_sink as _install_event_sink,
+    snapshot_event,
+    write_chrome_trace as _write_chrome_trace,
+)
+from textsummarization_on_flink_tpu.obs.registry import (
+    Counter,
+    DEFAULT_TIME_BUCKETS,
+    Gauge,
+    Histogram,
+    NULL_COUNTER,
+    NULL_GAUGE,
+    NULL_HISTOGRAM,
+    NULL_REGISTRY,
+    Registry,
+    exponential_buckets,
+)
+from textsummarization_on_flink_tpu.obs.spans import (
+    NULL_SPAN,
+    SpanRecord,
+    Tracer,
+    span as _span,
+    tracer_for,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "Tracer", "SpanRecord",
+    "EventSink", "NULL_REGISTRY", "NULL_COUNTER", "NULL_GAUGE",
+    "NULL_HISTOGRAM", "NULL_SPAN", "DEFAULT_TIME_BUCKETS",
+    "exponential_buckets", "enabled_from_env", "registry", "registry_for",
+    "set_default_registry", "use_registry", "counter", "gauge", "histogram",
+    "span", "render_text", "snapshot", "snapshot_event", "install_event_sink",
+    "write_chrome_trace", "tracer_for",
+]
+
+_default: Optional[Registry] = None
+_default_lock = threading.Lock()
+
+
+def enabled_from_env() -> bool:
+    """TS_OBS gate: unset/1/on/true/yes -> enabled; 0/off/false/no -> off."""
+    return os.environ.get("TS_OBS", "1").lower() not in (
+        "0", "off", "false", "no")
+
+
+def registry() -> Registry:
+    """The process-wide default registry (created on first use; honors
+    TS_OBS at creation time)."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = Registry(enabled=enabled_from_env())
+    return _default
+
+
+def set_default_registry(reg: Optional[Registry]) -> Registry:
+    """Swap the process default (None re-resolves TS_OBS on next use).
+    Returns the previous default (possibly None -> the new lazy one)."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, reg
+    return prev if prev is not None else registry()
+
+
+class use_registry:
+    """Context manager: route the module facade through `reg` (tests)."""
+
+    def __init__(self, reg: Registry):
+        self._reg = reg
+        self._prev: Optional[Registry] = None
+
+    def __enter__(self) -> Registry:
+        global _default
+        with _default_lock:
+            self._prev = _default
+            _default = self._reg
+        return self._reg
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        global _default
+        with _default_lock:
+            _default = self._prev
+
+
+def registry_for(hps: Any) -> Registry:
+    """The registry a component should report through: the process
+    default, unless the job's HParams carries obs=False (or the default
+    itself is disabled)."""
+    if hps is not None and not getattr(hps, "obs", True):
+        return NULL_REGISTRY
+    return registry()
+
+
+# -- module-level conveniences (route through the default registry) --
+
+def counter(name: str) -> Counter:
+    return registry().counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return registry().gauge(name)
+
+
+def histogram(name: str, buckets: Optional[Sequence[float]] = None,
+              ) -> Histogram:
+    return registry().histogram(name, buckets)
+
+
+def span(name: str, **attrs: Any):
+    return _span(registry(), name, **attrs)
+
+
+def render_text() -> str:
+    return registry().render_text()
+
+
+def snapshot(compact: bool = False) -> Dict[str, Dict]:
+    return registry().snapshot(compact=compact)
+
+
+def install_event_sink(directory: str, flush_secs: float = 2.0,
+                       max_queue: int = 4096,
+                       reg: Optional[Registry] = None) -> Optional[EventSink]:
+    return _install_event_sink(reg if reg is not None else registry(),
+                               directory, flush_secs=flush_secs,
+                               max_queue=max_queue)
+
+
+def write_chrome_trace(path: str, reg: Optional[Registry] = None) -> int:
+    return _write_chrome_trace(reg if reg is not None else registry(), path)

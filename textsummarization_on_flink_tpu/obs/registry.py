@@ -1,0 +1,399 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The reference system had no runtime telemetry beyond per-step loss
+prints (run_summarization.py:223-226); this registry is the substrate
+every layer of the rebuild reports through (OBSERVABILITY.md).
+
+Design constraints (ISSUE 1 tentpole):
+  * thread-safe — producer threads (batcher, prefetcher, bridge feeders)
+    and the train loop all hit the same metrics;
+  * near-zero-cost when disabled — a disabled registry hands out shared
+    null singletons whose mutators are empty methods, so instrumented
+    hot paths pay one attribute call and nothing else;
+  * histograms are fixed-bucket and percentile-queryable (numpy-checked
+    in tests/test_obs.py) — no unbounded sample retention;
+  * text exposition (`render_text`) is Prometheus-style so a scrape
+    endpoint can be bolted on without touching call sites.
+
+Metric names follow ``<layer>/<name>`` (train/step_time_seconds,
+decode/request_latency_seconds, ...); rendering flattens ``/`` and
+``-`` to ``_`` for exposition compatibility.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+def exponential_buckets(start: float, factor: float, count: int,
+                        ) -> Tuple[float, ...]:
+    """`count` ascending bucket upper bounds: start * factor**i."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise ValueError("need start > 0, factor > 1, count >= 1")
+    return tuple(start * factor ** i for i in range(count))
+
+
+# 1e-5 s .. ~168 s in x2 steps — covers a microsecond span probe through
+# a multi-minute checkpoint save with <=2x relative bucket error
+DEFAULT_TIME_BUCKETS = exponential_buckets(1e-5, 2.0, 24)
+
+
+class Counter:
+    """Monotonic float counter."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> Dict:
+        return {"type": "counter", "value": self._value}
+
+
+class Gauge:
+    """Last-write-wins instantaneous value.
+
+    Tracks whether it was ever written: a sampled gauge sitting at 0.0
+    (e.g. a starved queue-depth) is a real observation and must survive
+    a compact snapshot, unlike a gauge nothing ever touched.
+    """
+
+    __slots__ = ("name", "_value", "_lock", "touched")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+        self.touched = False
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+            self.touched = True
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+            self.touched = True
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+            self.touched = True
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> Dict:
+        return {"type": "gauge", "value": self._value}
+
+
+class Histogram:
+    """Fixed-bucket histogram with percentile queries.
+
+    `buckets` are ascending upper bounds; an implicit +inf bucket
+    catches the overflow.  `percentile(q)` linearly interpolates within
+    the winning bucket (the overflow bucket reports the observed max),
+    which tests pin against numpy within bucket resolution.
+    """
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count",
+                 "_min", "_max", "_lock")
+
+    def __init__(self, name: str,
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        bs = tuple(buckets if buckets is not None else DEFAULT_TIME_BUCKETS)
+        if not bs or any(b2 <= b1 for b1, b2 in zip(bs, bs[1:])):
+            raise ValueError(
+                f"histogram {name} needs ascending non-empty buckets")
+        self.buckets = bs
+        self._counts = [0] * (len(bs) + 1)  # +1 overflow
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def _bucket_index(self, v: float) -> int:
+        # bisect over a tuple of <=~30 bounds; branchless enough
+        lo, hi = 0, len(self.buckets)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if v <= self.buckets[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        return lo
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = self._bucket_index(v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def percentile(self, q: float) -> float:
+        """Value at quantile q in [0, 100], interpolated within the
+        bucket; 0.0 when empty."""
+        if not 0 <= q <= 100:
+            raise ValueError(f"q must be in [0, 100], got {q}")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            vmin, vmax = self._min, self._max
+        if total == 0:
+            return 0.0
+        rank = q / 100.0 * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            prev_cum = cum
+            cum += c
+            if cum >= rank:
+                lo = self.buckets[i - 1] if i > 0 else min(vmin, 0.0)
+                hi = self.buckets[i] if i < len(self.buckets) else vmax
+                # clamp the bucket edges to what was actually observed
+                lo = max(lo, vmin) if vmin != math.inf else lo
+                hi = min(hi, vmax) if vmax != -math.inf else hi
+                if hi <= lo or c == 0:
+                    return hi
+                frac = (rank - prev_cum) / c
+                return lo + frac * (hi - lo)
+        return vmax  # q == 100 falls through on float fuzz
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "type": "histogram",
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min if self._count else None,
+                "max": self._max if self._count else None,
+                "buckets": list(self.buckets),
+                "counts": list(self._counts),
+                "p50": None, "p99": None,  # filled below, outside the lock
+            }
+
+    def snapshot_with_percentiles(self) -> Dict:
+        s = self.snapshot()
+        s["p50"] = self.percentile(50)
+        s["p99"] = self.percentile(99)
+        return s
+
+
+# --------------------------------------------------------------------------
+# Null objects — the disabled fast path
+# --------------------------------------------------------------------------
+
+class _NullCounter:
+    __slots__ = ()
+    name = "<null>"
+    value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def snapshot(self) -> Dict:
+        return {"type": "counter", "value": 0.0}
+
+
+class _NullGauge:
+    __slots__ = ()
+    name = "<null>"
+    value = 0.0
+
+    def set(self, v: float) -> None:
+        pass
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def dec(self, n: float = 1.0) -> None:
+        pass
+
+    def snapshot(self) -> Dict:
+        return {"type": "gauge", "value": 0.0}
+
+
+class _NullHistogram:
+    __slots__ = ()
+    name = "<null>"
+    count = 0
+    sum = 0.0
+    mean = 0.0
+    buckets = ()
+
+    def observe(self, v: float) -> None:
+        pass
+
+    def percentile(self, q: float) -> float:
+        return 0.0
+
+    def snapshot(self) -> Dict:
+        return {"type": "histogram", "count": 0, "sum": 0.0}
+
+    snapshot_with_percentiles = snapshot
+
+
+NULL_COUNTER = _NullCounter()
+NULL_GAUGE = _NullGauge()
+NULL_HISTOGRAM = _NullHistogram()
+
+
+# --------------------------------------------------------------------------
+# Registry
+# --------------------------------------------------------------------------
+
+def _expo_name(name: str) -> str:
+    """`train/step_time_seconds` -> `train_step_time_seconds` (Prometheus
+    text exposition allows [a-zA-Z0-9_:] only)."""
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch in "_:") else "_")
+    return "".join(out)
+
+
+class Registry:
+    """Get-or-create metric namespace.  One instance is the process-wide
+    default (obs.registry()); tests construct their own for isolation."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        # span machinery lives here so swapping registries isolates it
+        # (wired by obs.spans.Tracer at first span())
+        self.tracer = None  # type: ignore[assignment]
+        self.event_sink = None  # obs.export.EventSink, when installed
+
+    def _get_or_create(self, name: str, cls, *args):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        if not self.enabled:
+            return NULL_COUNTER  # type: ignore[return-value]
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        if not self.enabled:
+            return NULL_GAUGE  # type: ignore[return-value]
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        if not self.enabled:
+            return NULL_HISTOGRAM  # type: ignore[return-value]
+        return self._get_or_create(name, Histogram, buckets)
+
+    def get(self, name: str):
+        """The metric registered under `name`, or None."""
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self, compact: bool = False) -> Dict[str, Dict]:
+        """{name: metric snapshot}.  compact=True drops metrics that were
+        never touched (zero counters, empty histograms, never-written
+        gauges) — the form BENCH rows embed (bench.py --obs-snapshot)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[str, Dict] = {}
+        for name, m in sorted(items):
+            if isinstance(m, Histogram):
+                s = m.snapshot_with_percentiles()
+                # bucket arrays are exposition detail, not snapshot payload
+                s.pop("buckets", None)
+                s.pop("counts", None)
+            else:
+                s = m.snapshot()
+            if compact:
+                if s["type"] == "histogram" and not s.get("count"):
+                    continue
+                if s["type"] == "counter" and not s.get("value"):
+                    continue
+                # a gauge legitimately at 0.0 (starved queue depth) is an
+                # observation, not an untouched metric — keep it
+                if s["type"] == "gauge" and not m.touched:
+                    continue
+            out[name] = s
+        return out
+
+    def render_text(self) -> str:
+        """Prometheus-style text exposition of every metric."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        lines: List[str] = []
+        for name, m in items:
+            ename = _expo_name(name)
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {ename} counter")
+                lines.append(f"{ename} {m.value:g}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {ename} gauge")
+                lines.append(f"{ename} {m.value:g}")
+            elif isinstance(m, Histogram):
+                snap = m.snapshot()
+                lines.append(f"# TYPE {ename} histogram")
+                cum = 0
+                for bound, c in zip(snap["buckets"], snap["counts"]):
+                    cum += c
+                    lines.append(f'{ename}_bucket{{le="{bound:g}"}} {cum}')
+                cum += snap["counts"][-1]
+                lines.append(f'{ename}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f"{ename}_sum {snap['sum']:g}")
+                lines.append(f"{ename}_count {snap['count']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        """Drop every metric (tests)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+NULL_REGISTRY = Registry(enabled=False)

@@ -1,0 +1,210 @@
+"""Lightweight span tracing: ``with obs.span("decode/beam_step"): ...``.
+
+Spans nest (a thread-local stack tracks depth/parent), record both
+wall-clock start (epoch, for cross-process alignment) and monotonic
+duration (perf_counter, for arithmetic), and land in a bounded
+per-registry ring buffer — a long-running server never grows without
+bound; overflow is counted in ``obs/spans_dropped_total``.
+
+Two export shapes:
+  * Chrome-trace events (`chrome_trace_events`) — 'ph': 'X' complete
+    events in the exact dialect scripts/trace_summary.py summarizes
+    (same tool as the jax.profiler captures);
+  * unified JSONL records (`{"kind": "span", ...}`) pushed to the
+    registry's EventSink when one is installed (obs/export.py), sharing
+    the `<log_root>/<exp>/<job>/events.jsonl` file with SummaryWriter
+    scalars.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from textsummarization_on_flink_tpu.obs.registry import Registry
+
+DEFAULT_MAX_SPANS = 10_000
+
+
+class SpanRecord:
+    __slots__ = ("name", "wall_start", "duration", "depth", "parent",
+                 "thread_id", "thread_name", "attrs")
+
+    def __init__(self, name: str, wall_start: float, duration: float,
+                 depth: int, parent: Optional[str], thread_id: int,
+                 thread_name: str, attrs: Optional[Dict[str, Any]]):
+        self.name = name
+        self.wall_start = wall_start  # epoch seconds
+        self.duration = duration  # monotonic seconds
+        self.depth = depth
+        self.parent = parent
+        self.thread_id = thread_id
+        self.thread_name = thread_name
+        self.attrs = attrs
+
+    def as_event(self) -> Dict[str, Any]:
+        """The unified events.jsonl record shape."""
+        rec: Dict[str, Any] = {
+            "kind": "span",
+            "name": self.name,
+            "ts_us": int(self.wall_start * 1e6),
+            "dur_us": int(self.duration * 1e6),
+            "depth": self.depth,
+            "pid": os.getpid(),
+            "tid": self.thread_id,
+        }
+        if self.parent:
+            rec["parent"] = self.parent
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        return rec
+
+    def as_chrome_event(self) -> Dict[str, Any]:
+        """A Chrome-trace complete event ('ph': 'X', microsecond units)."""
+        ev: Dict[str, Any] = {
+            "ph": "X",
+            "name": self.name,
+            "ts": self.wall_start * 1e6,
+            "dur": self.duration * 1e6,
+            "pid": os.getpid(),
+            "tid": self.thread_id,
+        }
+        args = dict(self.attrs or {})
+        if self.parent:
+            args["parent"] = self.parent
+        if args:
+            ev["args"] = args
+        return ev
+
+
+class _SpanContext:
+    """The live context-manager handed out by Tracer.span()."""
+
+    __slots__ = ("_tracer", "name", "attrs", "_t0", "_wall0")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 attrs: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self._t0 = 0.0
+        self._wall0 = 0.0
+
+    def __enter__(self) -> "_SpanContext":
+        stack = self._tracer._stack()
+        stack.append(self.name)
+        self._wall0 = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur = time.perf_counter() - self._t0
+        stack = self._tracer._stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        parent = stack[-1] if stack else None
+        t = threading.current_thread()
+        self._tracer._record(SpanRecord(
+            self.name, self._wall0, dur, depth=len(stack), parent=parent,
+            thread_id=t.ident or 0, thread_name=t.name, attrs=self.attrs))
+
+
+class _NullSpan:
+    """Disabled-mode span: enter/exit do nothing.  Shared singleton —
+    the hot-path cost of a disabled span is two empty method calls."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Per-registry span collector (bounded ring buffer)."""
+
+    def __init__(self, registry: Registry, max_spans: int = DEFAULT_MAX_SPANS):
+        self._registry = registry
+        self._spans: "collections.deque[SpanRecord]" = collections.deque(
+            maxlen=max_spans)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._dropped = registry.counter("obs/spans_dropped_total")
+
+    def _stack(self) -> List[str]:
+        s = getattr(self._local, "stack", None)
+        if s is None:
+            s = []
+            self._local.stack = s
+        return s
+
+    def _record(self, rec: SpanRecord) -> None:
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped.inc()
+            self._spans.append(rec)
+        sink = self._registry.event_sink
+        if sink is not None:
+            sink.emit(rec.as_event())
+
+    def span(self, name: str, **attrs: Any) -> _SpanContext:
+        return _SpanContext(self, name, attrs or None)
+
+    def finished(self) -> List[SpanRecord]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def chrome_trace_events(self) -> List[Dict[str, Any]]:
+        """All buffered spans as Chrome-trace events plus process/thread
+        metadata rows — directly loadable by scripts/trace_summary.py."""
+        spans = self.finished()
+        events: List[Dict[str, Any]] = [{
+            "ph": "M", "name": "process_name", "pid": os.getpid(),
+            "args": {"name": "obs"},
+        }]
+        seen_tids = {}
+        for s in spans:
+            if s.thread_id not in seen_tids:
+                seen_tids[s.thread_id] = s.thread_name
+        for tid, tname in seen_tids.items():
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": os.getpid(), "tid": tid,
+                           "args": {"name": tname}})
+        events.extend(s.as_chrome_event() for s in spans)
+        return events
+
+
+_tracer_init_lock = threading.Lock()
+
+
+def tracer_for(registry: Registry) -> Tracer:
+    """The registry's tracer, created on first use (double-checked under
+    a module lock so concurrent first spans share one buffer)."""
+    t = registry.tracer
+    if t is None:
+        with _tracer_init_lock:
+            t = registry.tracer
+            if t is None:
+                t = Tracer(registry)
+                registry.tracer = t
+    return t
+
+
+def span(registry: Registry, name: str, **attrs: Any):
+    """Context manager recording one span into `registry` (the module
+    facade obs.span() routes here with the default registry)."""
+    if not registry.enabled:
+        return NULL_SPAN
+    return tracer_for(registry).span(name, **attrs)

@@ -23,6 +23,7 @@ import logging
 import shlex
 from typing import Optional
 
+from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.data.vocab import Vocab
 from textsummarization_on_flink_tpu.pipeline.estimator import (
@@ -114,7 +115,11 @@ class App:
         src = source or KafkaSource(TRAIN_TOPIC, self.bootstrap_servers,
                                     max_count=max_count)
         estimator = self.create_estimator()
-        model = estimator.fit(src)
+        reg = obs.registry_for(self.train_hps)
+        # end-to-end job span (the fit/transform stage spans nest inside)
+        with obs.spans.span(reg, "pipeline/train_job"):
+            model = estimator.fit(src)
+        reg.counter("pipeline/train_jobs_total").inc()
         model_json = model.to_json()
         log.info("trained model config: %s", model_json)
         return model_json
@@ -133,7 +138,11 @@ class App:
                 model.with_vocab(self.vocab)
         else:
             model = self.create_model()
-        return model.transform(src, out)
+        reg = obs.registry_for(self.inference_hps)
+        with obs.spans.span(reg, "pipeline/inference_job"):
+            result = model.transform(src, out)
+        reg.counter("pipeline/inference_jobs_total").inc()
+        return result
 
     def main(self, train_source: Optional[Source] = None,
              infer_source: Optional[Source] = None,

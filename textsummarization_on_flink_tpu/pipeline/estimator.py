@@ -39,6 +39,7 @@ import re
 import threading
 from typing import Iterator, List, Optional, Tuple
 
+from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.data.batcher import Batcher
@@ -146,11 +147,15 @@ class _BridgeFeeder:
     """
 
     def __init__(self, source: Source, selected_cols: List[str],
-                 coding: ExampleCoding, q: bridge_lib.RecordQueue):
+                 coding: ExampleCoding, q: bridge_lib.RecordQueue,
+                 registry=None):
         self._source = source
         self._cols = selected_cols
         self._coding = coding
         self._q = q
+        # the job's registry, not the process default — HParams(obs=False)
+        # must run the feeder dark too
+        self._reg = registry if registry is not None else obs.registry()
         self.error: Optional[BaseException] = None
         self.thread = threading.Thread(target=self._run, daemon=True)
 
@@ -159,10 +164,21 @@ class _BridgeFeeder:
         return self
 
     def _run(self) -> None:
+        c_rows = self._reg.counter("pipeline/rows_in_total")
+        c_codec = self._reg.counter("pipeline/codec_errors_total")
         try:
             for row in self._source.rows():
                 projected = self._source.schema.project_row(row, self._cols)
-                if not self._q.put(self._coding.encode(projected)):
+                try:
+                    rec = self._coding.encode(projected)
+                except (TypeError, ValueError, KeyError):
+                    # a row the codec cannot encode is a poisoned stream,
+                    # not a skippable record — count it, then fail the job
+                    # through the established raise_if_failed path
+                    c_codec.inc()
+                    raise
+                c_rows.inc()
+                if not self._q.put(rec):
                     if self._q.closed:  # consumer finished early: cancel
                         log.info("record queue closed by consumer; "
                                  "cancelling source stream")
@@ -249,7 +265,8 @@ class SummarizationModel(Model,
         in_schema = source.schema.select(sel)
         coding = ExampleCoding(in_schema, in_schema)
         q = bridge_lib.make_record_queue()
-        feeder = _BridgeFeeder(source, sel, coding, q).start()
+        reg = obs.registry_for(hps)
+        feeder = _BridgeFeeder(source, sel, coding, q, registry=reg).start()
 
         def example_source():
             # inference has no gold abstract; reference text rides along
@@ -264,10 +281,16 @@ class SummarizationModel(Model,
             train_dir=train_dir,
             decode_root=os.path.join(hps.log_root or ".",
                                      hps.exp_name or "exp"))
+        c_out = reg.counter("pipeline/rows_out_total")
+
+        def emit(res):
+            out_sink.write(res.as_row())
+            c_out.inc()
+
         try:
-            decoder.decode(
-                result_sink=lambda res: out_sink.write(res.as_row()),
-                max_batches=max_batches, log_results=False)
+            with obs.spans.span(reg, "pipeline/transform"):
+                decoder.decode(result_sink=emit, max_batches=max_batches,
+                               log_results=False)
         finally:
             feeder.finish()
         return out_sink
@@ -314,7 +337,8 @@ class SummarizationEstimator(Estimator,
         in_schema = source.schema.select(sel)
         coding = ExampleCoding(in_schema, in_schema)
         q = bridge_lib.make_record_queue()
-        feeder = _BridgeFeeder(source, sel, coding, q).start()
+        feeder = _BridgeFeeder(source, sel, coding, q,
+                               registry=obs.registry_for(hps)).start()
 
         def example_source():
             return rows_to_examples(_rows_from_queue(q, coding))
@@ -332,7 +356,8 @@ class SummarizationEstimator(Estimator,
                                       state=state, checkpointer=checkpointer,
                                       train_dir=train_dir)
         try:
-            trainer.train(num_steps=hps.num_steps)
+            with obs.spans.span(obs.registry_for(hps), "pipeline/fit"):
+                trainer.train(num_steps=hps.num_steps)
         finally:
             feeder.finish()
 

@@ -27,9 +27,19 @@ import socket as socket_lib
 import threading
 from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 
+from textsummarization_on_flink_tpu import obs
+
 log = logging.getLogger(__name__)
 
 Row = Tuple[Any, ...]
+
+
+def _count_source_row() -> None:
+    obs.counter("pipeline/source_rows_total").inc()
+
+
+def _count_sink_row() -> None:
+    obs.counter("pipeline/sink_rows_total").inc()
 
 
 # --------------------------------------------------------------------------
@@ -154,7 +164,9 @@ class CollectionSource(Source):
         self.schema = schema or ARTICLE_INPUT_SCHEMA
 
     def rows(self) -> Iterator[Row]:
-        return iter(self._rows)
+        for row in self._rows:
+            _count_source_row()
+            yield row
 
 
 class SocketSource(Source):
@@ -186,7 +198,16 @@ class SocketSource(Source):
                 line = line.strip()
                 if not line:
                     continue
-                yield Message.from_json(line).to_row()
+                try:
+                    row = Message.from_json(line).to_row()
+                except (ValueError, TypeError):
+                    # a malformed line must not kill a long-lived stream;
+                    # counted so a lossy producer is visible
+                    obs.counter("pipeline/codec_errors_total").inc()
+                    log.warning("dropping malformed socket line: %.80r", line)
+                    continue
+                _count_source_row()
+                yield row
                 n += 1
                 if self._max and n >= self._max:
                     return
@@ -229,7 +250,14 @@ class KafkaSource(Source):
             group_id=self.group_id, value_deserializer=lambda b: b)
         n = 0
         for msg in consumer:  # pragma: no cover - needs a broker
-            yield Message.from_json(msg.value.decode("utf-8")).to_row()
+            try:
+                row = Message.from_json(msg.value.decode("utf-8")).to_row()
+            except (ValueError, TypeError):
+                obs.counter("pipeline/codec_errors_total").inc()
+                log.warning("dropping malformed kafka message")
+                continue
+            _count_source_row()
+            yield row
             n += 1
             if self._max and n >= self._max:
                 return
@@ -255,6 +283,7 @@ class CollectionSink(Sink):
     def write(self, row: Row) -> None:
         with self._lock:
             self.rows.append(row)
+        _count_sink_row()
 
 
 class PrintSink(Sink):
@@ -262,6 +291,7 @@ class PrintSink(Sink):
 
     def write(self, row: Row) -> None:
         print(row, flush=True)
+        _count_sink_row()
 
 
 class SocketSink(Sink):
@@ -271,6 +301,7 @@ class SocketSink(Sink):
     def write(self, row: Row) -> None:
         data = (Message.from_row(row).to_json() + "\n").encode("utf-8")
         self._sock.sendall(data)  # immediate flush
+        _count_sink_row()
 
     def close(self) -> None:
         try:
@@ -302,6 +333,7 @@ class KafkaSink(Sink):
         p = self._ensure()
         p.send(self.topic, Message.from_row(row).to_json().encode("utf-8"))
         p.flush()  # immediate flush
+        _count_sink_row()
 
     def close(self) -> None:  # pragma: no cover
         if self._producer is not None:
@@ -316,3 +348,4 @@ class QueueSink(Sink):
 
     def write(self, row: Row) -> None:
         self.queue.put(row)
+        _count_sink_row()
