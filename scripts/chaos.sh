@@ -1,0 +1,149 @@
+#!/usr/bin/env bash
+# Chaos harness (RESILIENCE.md): drive every recovery path under
+# deterministic fault injection.
+#
+#   scripts/chaos.sh          # chaos/resilience/bridge suites + TS_FAULTS sweeps
+#
+# Two layers:
+#   1. the pytest chaos suite — each test pins its own fault plan
+#      (seeded, via HParams(faults=...) or faultinject.use_plan), so the
+#      exact same call indices fail on every run;
+#   2. TS_FAULTS sweeps — the PROCESS-WIDE env arming path, exercised by
+#      small end-to-end smokes per injection point (train divergence
+#      recovery, source reconnect, checkpoint fallback, etl worker
+#      restarts), asserting recovery through the resilience/* counters.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export PYTHONPATH="$PWD"
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+echo "== chaos + resilience + bridge suites (pinned per-test fault plans)"
+python -m pytest tests/test_chaos.py tests/test_resilience.py \
+  tests/test_bridge.py -q -p no:cacheprovider
+
+echo
+echo "== TS_FAULTS sweep: train.step_nan (divergence recovery end-to-end)"
+TS_FAULTS="train.step_nan:1.0:7:3" python - <<'PY'
+import numpy as np
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.batching import Batch, SummaryExample
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+import tempfile
+
+hps = HParams(batch_size=2, max_enc_steps=6, max_dec_steps=5, min_dec_steps=1,
+              hidden_dim=4, emb_dim=3, max_oov_buckets=2, vocab_size=0,
+              nan_skip_steps=2, nan_max_rollbacks=1,
+              log_root=tempfile.mkdtemp(), exp_name="chaos")
+vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+exs = [SummaryExample.build("a b c d", ["b c ."], vocab, hps),
+       SummaryExample.build("c d e f", ["d e ."], vocab, hps)]
+batch = Batch(exs, hps, vocab)
+
+class FixedBatcher:
+    n = 30
+    def next_batch(self):
+        if self.n <= 0:
+            return None
+        self.n -= 1
+        return batch
+
+trainer = trainer_lib.Trainer(hps, vocab.size(), FixedBatcher())
+state = trainer.train(num_steps=6)
+assert int(np.asarray(state.step)) == 6, "training did not complete"
+skips = obs.counter("resilience/train/nan_skips_total").value
+rollbacks = obs.counter("resilience/train/rollbacks_total").value
+assert (skips, rollbacks) == (2, 1), (skips, rollbacks)
+print(f"train.step_nan OK: {int(skips)} skips, {int(rollbacks)} rollback, "
+      f"resumed to step 6 with no manual intervention")
+PY
+
+echo
+echo "== TS_FAULTS sweep: io.read (source reconnect, exactly-once)"
+TS_FAULTS="io.read:1.0:0:2" python - <<'PY'
+import socketserver, threading
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.pipeline import io as io_lib
+from textsummarization_on_flink_tpu.resilience import faultinject
+
+lines = [io_lib.Message(f"u{i}", f"art {i}", "", "r").to_json()
+         for i in range(5)]
+
+class H(socketserver.StreamRequestHandler):
+    def handle(self):
+        try:
+            for line in lines:
+                self.wfile.write((line + "\n").encode())
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+srv.daemon_threads = True
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+src = io_lib.ResilientSource(
+    lambda: io_lib.SocketSource("127.0.0.1", srv.server_address[1],
+                                max_count=5),
+    max_reconnects=4, seed=0, sleep=lambda d: None)
+rows = list(src.rows())
+srv.shutdown(); srv.server_close()
+assert [r[0] for r in rows] == [f"u{i}" for i in range(5)], rows
+fires = faultinject.plan().stats()["io.read"]["fires"]
+reconnects = obs.counter("resilience/io_reconnects_total").value
+assert fires == 2 and reconnects == 2, (fires, reconnects)
+print(f"io.read OK: {fires} injected faults, {int(reconnects)} reconnects, "
+      f"5 rows delivered exactly once")
+PY
+
+echo
+echo "== TS_FAULTS sweep: ckpt.load (corruption fallback chain)"
+TS_FAULTS="ckpt.load:1.0:0:1" python - <<'PY'
+import tempfile
+import numpy as np
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+
+hps = HParams(batch_size=2, max_enc_steps=6, max_dec_steps=5, min_dec_steps=1,
+              hidden_dim=4, emb_dim=3, max_oov_buckets=2, vocab_size=0)
+d = tempfile.mkdtemp()
+ck = ckpt_lib.Checkpointer(d, hps=hps)
+s1 = trainer_lib.init_train_state(hps, vsize=12, seed=0)
+ck.save(s1)
+ck.save(s1._replace(step=s1.step + 5))
+restored = ck.restore()  # newest load fails (injected) -> next-older serves
+assert restored is not None
+assert int(np.asarray(restored.step)) == int(np.asarray(s1.step))
+fallbacks = obs.counter("resilience/ckpt_fallbacks_total").value
+assert fallbacks == 1, fallbacks
+print("ckpt.load OK: corrupt-latest fell back to the next-older checkpoint")
+PY
+
+echo
+echo "== TS_FAULTS sweep: etl.worker (bounded restart budget)"
+TS_FAULTS="etl.worker:1.0:0:2" python - <<'PY'
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.batcher import Batcher
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+
+hps = HParams(batch_size=2, max_enc_steps=6, max_dec_steps=5, min_dec_steps=1,
+              hidden_dim=4, emb_dim=3, max_oov_buckets=2, vocab_size=0)
+vocab = Vocab(words=["the", "cat", "sat", "on", "mat", "."])
+b = Batcher("", vocab, hps, single_pass=True,
+            example_source=lambda: iter(
+                [("the cat sat", "<s> the cat . </s>")] * 4),
+            max_worker_restarts=3)
+n = 0
+while b.next_batch() is not None:
+    n += 1
+restarts = obs.counter("resilience/etl_worker_restarts_total").value
+assert n == 2 and restarts == 2, (n, restarts)
+print(f"etl.worker OK: {int(restarts)} crash restarts, data still flowed")
+PY
+
+echo
+echo "chaos OK"

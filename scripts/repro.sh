@@ -19,9 +19,17 @@ bash scripts/lint.sh
 echo "== telemetry smoke (obs registry/spans/exporters)"
 python -m pytest tests/test_obs*.py -q -p no:cacheprovider
 
+echo "== chaos smoke (resilience primitives + seeded fault injection)"
+# fast, deterministic recovery-path checks (RESILIENCE.md); the full
+# TS_FAULTS end-to-end sweeps live in scripts/chaos.sh
+python -m pytest tests/test_resilience.py tests/test_chaos.py \
+  tests/test_bridge.py -q -p no:cacheprovider
+
 echo "== test suite"
-# obs tests already ran in the smoke step above — skip the rerun
-OBS_SKIP=(--ignore=tests/test_obs.py --ignore=tests/test_obs_integration.py)
+# obs/chaos tests already ran in the smoke steps above — skip the rerun
+OBS_SKIP=(--ignore=tests/test_obs.py --ignore=tests/test_obs_integration.py
+          --ignore=tests/test_resilience.py --ignore=tests/test_chaos.py
+          --ignore=tests/test_bridge.py)
 if [ "${1:-fast}" = "full" ]; then
   python -m pytest tests/ -q "${OBS_SKIP[@]}"
 else

@@ -55,3 +55,9 @@ def pytest_configure(config):
         "slow: long-running test (>=20s: multiprocess runs, dryruns, "
         "full-scale compiles).  Fast iteration: -m 'not slow' (~half the "
         "suite wall clock); the full suite gates round-end.")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection recovery test "
+        "(RESILIENCE.md).  Select with -m chaos (scripts/chaos.sh runs "
+        "these under TS_FAULTS sweeps); all are seeded and CPU-fast, so "
+        "they also run in the default suite.")

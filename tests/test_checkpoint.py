@@ -91,7 +91,8 @@ def test_best_model_saver_keeps_one(tmp_path, state):
     bs(state.params, 3.0, 10)
     bs(state.params, 2.5, 20)
     files = [f for f in os.listdir(tmp_path) if f.startswith("bestmodel")]
-    assert files == ["bestmodel-20.npz"]
+    # one checkpoint + its checksum manifest sidecar (RESILIENCE.md)
+    assert sorted(files) == ["bestmodel-20.npz", "bestmodel-20.npz.sum"]
     assert latest_checkpoint(
         str(tmp_path), ckpt_lib.BEST_INDEX_FILE).endswith("bestmodel-20.npz")
 

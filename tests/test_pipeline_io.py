@@ -227,6 +227,7 @@ class _FakeBroker:
         self.topics = {}
         self.flushes = 0
         self.consumer_kwargs = None
+        self.consumers = []
 
     def make_module(self):
         """A module-like namespace standing in for `kafka` in sys.modules."""
@@ -236,11 +237,13 @@ class _FakeBroker:
 
         class KafkaConsumer:
             def __init__(self, topic, bootstrap_servers=None, group_id=None,
-                         value_deserializer=None):
+                         value_deserializer=None, **kwargs):
                 broker.consumer_kwargs = {
                     "topic": topic, "bootstrap_servers": bootstrap_servers,
-                    "group_id": group_id}
+                    "group_id": group_id, **kwargs}
                 deser = value_deserializer or (lambda b: b)
+                self.closed = False
+                broker.consumers.append(self)
 
                 class _Msg:
                     def __init__(self, value):
@@ -251,6 +254,9 @@ class _FakeBroker:
 
             def __iter__(self):
                 return iter(self._msgs)
+
+            def close(self):  # the real KafkaConsumer leaves its group
+                self.closed = True
 
         class KafkaProducer:
             def __init__(self, bootstrap_servers=None):
@@ -302,6 +308,9 @@ def test_kafka_roundtrip_through_fake_broker(fake_kafka):
     assert list(src.rows()) == rows
     assert fake_kafka.consumer_kwargs["bootstrap_servers"] == "fake:9092"
     assert fake_kafka.consumer_kwargs["group_id"] == "g1"
+    # the consumer must leave its group on every exit path (an abandoned
+    # one forces a rebalance per reconnect)
+    assert all(c.closed for c in fake_kafka.consumers)
 
 
 def test_kafka_source_max_count_bounds_stream(fake_kafka):

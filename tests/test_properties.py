@@ -7,8 +7,14 @@ which example-based tests can only spot-check."""
 
 import os
 
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+# optional dependency: without hypothesis these skip instead of breaking
+# collection for the whole suite
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from textsummarization_on_flink_tpu.data import TFExample, Vocab
 from textsummarization_on_flink_tpu.data.chunks import (

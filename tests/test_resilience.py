@@ -1,0 +1,372 @@
+"""Unit tests for the resilience primitives (RESILIENCE.md).
+
+Covers resilience/policy.py (RetryPolicy, Deadline, CircuitBreaker),
+resilience/faultinject.py (spec parsing, deterministic seeded firing,
+budgets, gating), the typed error vocabulary, and the HParams-level
+validation of the new resilience fields.  End-to-end recovery paths are
+exercised by the chaos suite (tests/test_chaos.py).
+"""
+
+import pytest
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.obs import Registry
+from textsummarization_on_flink_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceededError,
+    FaultPlan,
+    FaultSpec,
+    NULL_PLAN,
+    ResilienceError,
+    RetriesExhaustedError,
+    RetryPolicy,
+    StreamIdleError,
+    WorkerCrashError,
+    faultinject,
+)
+
+
+# -- typed errors ----------------------------------------------------------
+
+def test_error_taxonomy():
+    # timeouts stay catchable as TimeoutError, worker crashes as
+    # RuntimeError — pre-existing handlers must keep working
+    assert issubclass(StreamIdleError, TimeoutError)
+    assert issubclass(DeadlineExceededError, TimeoutError)
+    assert issubclass(WorkerCrashError, RuntimeError)
+    for err in (StreamIdleError, DeadlineExceededError, CircuitOpenError,
+                RetriesExhaustedError, WorkerCrashError):
+        assert issubclass(err, ResilienceError)
+
+
+# -- Deadline --------------------------------------------------------------
+
+class TestDeadline:
+    def test_never_is_unbounded(self):
+        d = Deadline.never()
+        assert not d.bounded
+        assert d.remaining() == float("inf")
+        assert not d.expired()
+        d.check()  # never raises
+
+    def test_after_zero_or_none_means_never(self):
+        assert not Deadline.after(0).bounded
+        assert not Deadline.after(None).bounded
+        assert not Deadline.after(-1).bounded
+
+    def test_bounded_expiry(self):
+        d = Deadline.after(1000.0)
+        assert d.bounded
+        assert 0 < d.remaining() <= 1000.0
+        d.check()
+        expired = Deadline.after(1e-9)
+        # the budget is sub-nanosecond: it has expired by the time we ask
+        assert expired.expired()
+        with pytest.raises(DeadlineExceededError, match="during decode"):
+            expired.check("decode")
+        assert expired.remaining() == 0.0
+
+    def test_timeout_for_clamps_to_budget(self):
+        assert Deadline.never().timeout_for(5.0) == 5.0
+        assert Deadline.never().timeout_for(None) is None
+        d = Deadline.after(1000.0)
+        assert d.timeout_for(5.0) == 5.0  # budget >> default
+        assert 0 < d.timeout_for(None) <= 1000.0  # just the budget
+
+
+# -- RetryPolicy -----------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        sleeps = []
+        policy = RetryPolicy(max_attempts=5, base_delay=0.01, seed=0,
+                             sleep=sleeps.append, registry=Registry())
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert policy.call(flaky, retry_on=(OSError,)) == "ok"
+        assert calls["n"] == 3
+        assert len(sleeps) == 2  # slept before each retry, not the first try
+
+    def test_exhaustion_raises_typed_with_cause(self):
+        policy = RetryPolicy(max_attempts=3, base_delay=0.01, seed=0,
+                             sleep=lambda d: None, registry=Registry())
+
+        def always_fails():
+            raise OSError("dead peer")
+
+        with pytest.raises(RetriesExhaustedError, match="3 attempts") as ei:
+            policy.call(always_fails, retry_on=(OSError,))
+        assert isinstance(ei.value.__cause__, OSError)
+
+    def test_seeded_backoff_is_deterministic_and_bounded(self):
+        def delays(seed):
+            p = RetryPolicy(max_attempts=8, base_delay=0.05, max_delay=1.0,
+                            seed=seed, registry=Registry())
+            return [p.next_delay() for _ in range(7)]
+
+        a, b = delays(42), delays(42)
+        assert a == b  # same seed -> same decorrelated-jitter sequence
+        assert delays(7) != a  # different seed -> different sequence
+        assert all(0.05 <= d <= 1.0 for d in a)  # within [base, cap]
+
+    def test_unexpected_error_not_retried(self):
+        policy = RetryPolicy(max_attempts=5, base_delay=0.01,
+                             sleep=lambda d: None, registry=Registry())
+
+        def bug():
+            raise KeyError("not a transient error")
+
+        with pytest.raises(KeyError):
+            policy.call(bug, retry_on=(OSError,))
+
+    def test_deadline_bounds_retrying(self):
+        # deadline already expired: the first retry sleep surfaces the
+        # typed timeout instead of grinding through all attempts
+        policy = RetryPolicy(max_attempts=50, base_delay=0.01, seed=0,
+                             sleep=lambda d: None,
+                             deadline=Deadline.after(1e-9),
+                             registry=Registry())
+
+        def always_fails():
+            raise OSError("down")
+
+        with pytest.raises(DeadlineExceededError) as ei:
+            policy.call(always_fails, retry_on=(OSError,))
+        assert isinstance(ei.value.__cause__, OSError)
+
+    def test_expired_deadline_sleeps_nothing_before_raising(self):
+        # the backoff sleep is clamped to the remaining budget: with the
+        # deadline already spent it must be ~0, not the full delay
+        slept = []
+        policy = RetryPolicy(max_attempts=5, base_delay=5.0, max_delay=30.0,
+                             seed=0, sleep=slept.append,
+                             deadline=Deadline.after(1e-9),
+                             registry=Registry())
+        with pytest.raises(DeadlineExceededError):
+            policy.call(lambda: (_ for _ in ()).throw(OSError("down")),
+                        retry_on=(OSError,))
+        assert len(slept) == 1 and slept[0] < 0.01, slept
+
+    def test_obs_counters(self):
+        reg = Registry()
+        policy = RetryPolicy(max_attempts=3, base_delay=0.01, seed=0,
+                             name="io.test", sleep=lambda d: None,
+                             registry=reg)
+        with pytest.raises(RetriesExhaustedError):
+            policy.call(lambda: (_ for _ in ()).throw(OSError()),
+                        retry_on=(OSError,))
+        assert reg.counter("resilience/io.test/retries_total").value == 2
+        assert reg.counter(
+            "resilience/io.test/retry_exhausted_total").value == 1
+        assert reg.counter("resilience/retries_total").value == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_attempts"):
+            RetryPolicy(max_attempts=0, registry=Registry())
+        with pytest.raises(ValueError, match="base_delay"):
+            RetryPolicy(base_delay=0.0, registry=Registry())
+        with pytest.raises(ValueError, match="base_delay"):
+            RetryPolicy(base_delay=1.0, max_delay=0.5, registry=Registry())
+
+
+# -- CircuitBreaker --------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCircuitBreaker:
+    def make(self, threshold=3, reset_secs=30.0):
+        clock = FakeClock()
+        reg = Registry()
+        br = CircuitBreaker(threshold=threshold, reset_secs=reset_secs,
+                            name="t", clock=clock, registry=reg)
+        return br, clock, reg
+
+    def test_trips_after_consecutive_failures(self):
+        br, _, reg = self.make(threshold=3)
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == CircuitBreaker.CLOSED
+        br.record_success()  # resets the consecutive count
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == CircuitBreaker.CLOSED
+        br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow()
+        assert reg.counter("resilience/t/breaker_trips_total").value == 1
+        assert reg.counter("resilience/t/breaker_shed_total").value == 1
+        assert reg.gauge("resilience/t/breaker_state").value == 2
+
+    def test_half_open_probe_recloses_on_success(self):
+        br, clock, reg = self.make(threshold=1, reset_secs=30.0)
+        br.record_failure()
+        assert not br.allow()
+        clock.t = 31.0
+        assert br.state == CircuitBreaker.HALF_OPEN
+        assert br.allow()       # the single probe
+        assert not br.allow()   # concurrent callers still shed
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED
+        assert br.allow()
+        assert reg.gauge("resilience/t/breaker_state").value == 0
+
+    def test_half_open_probe_failure_reopens(self):
+        br, clock, _ = self.make(threshold=1, reset_secs=30.0)
+        br.record_failure()
+        clock.t = 31.0
+        assert br.allow()  # probe
+        br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        clock.t = 60.0  # 29s after the re-open: clock restarted
+        assert br.state == CircuitBreaker.OPEN
+        clock.t = 61.5
+        assert br.state == CircuitBreaker.HALF_OPEN
+
+    def test_context_manager(self):
+        br, clock, _ = self.make(threshold=1)
+        with pytest.raises(OSError):
+            with br:
+                raise OSError("down")
+        assert br.state == CircuitBreaker.OPEN
+        with pytest.raises(CircuitOpenError):
+            with br:
+                pass
+        clock.t = 31.0
+        with br:
+            pass  # probe succeeds
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="threshold"):
+            CircuitBreaker(threshold=0, registry=Registry())
+
+
+# -- fault injection -------------------------------------------------------
+
+class TestFaultSpecs:
+    def test_parse_full_string(self):
+        specs = faultinject.parse("io.read:0.2:42,train.step_nan:1.0:7:3")
+        assert specs == [FaultSpec("io.read", 0.2, 42, 0),
+                         FaultSpec("train.step_nan", 1.0, 7, 3)]
+        assert faultinject.parse("") == []
+        assert faultinject.parse(None) == []
+
+    @pytest.mark.parametrize("bad", [
+        "io.read",                  # missing fields
+        "io.read:0.5",              # missing seed
+        "no.such.point:0.5:1",      # unknown point (typo safety)
+        "io.read:1.5:1",            # prob out of range
+        "io.read:-0.1:1",           # prob out of range
+        "io.read:0.5:1:-2",         # negative max
+        "io.read:0.5:1:2:9",        # too many fields
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            faultinject.parse(bad)
+
+    def test_known_points_cover_the_documented_set(self):
+        assert set(faultinject.KNOWN_POINTS) == {
+            "io.connect", "io.read", "io.write",
+            "ckpt.load", "train.step_nan", "etl.worker"}
+
+
+class TestFaultPlan:
+    def test_seeded_firing_is_deterministic(self):
+        def fire_pattern(seed):
+            plan = FaultPlan([FaultSpec("io.read", 0.5, seed, 0)],
+                             registry=Registry())
+            return [plan.fire("io.read") for _ in range(32)]
+
+        a, b = fire_pattern(42), fire_pattern(42)
+        assert a == b                 # same seed -> same call indices fire
+        assert any(a) and not all(a)  # p=0.5 over 32 calls: both outcomes
+        assert fire_pattern(7) != a   # a different seed fires differently
+
+    def test_max_fires_budget(self):
+        plan = FaultPlan([FaultSpec("io.read", 1.0, 0, 3)],
+                         registry=Registry())
+        fired = [plan.fire("io.read") for _ in range(10)]
+        assert fired == [True] * 3 + [False] * 7  # heals after 3 fires
+        assert plan.stats() == {"io.read": {"calls": 10, "fires": 3}}
+
+    def test_unarmed_point_never_fires(self):
+        reg = Registry()
+        plan = FaultPlan([FaultSpec("io.read", 1.0, 0, 0)], registry=reg)
+        assert not plan.fire("ckpt.load")
+        assert not plan.armed("ckpt.load")
+        assert plan.armed("io.read")
+        assert plan.fire("io.read")
+        assert reg.counter("resilience/fault/io.read").value == 1
+        assert reg.counter("resilience/faults_fired_total").value == 1
+
+    def test_null_plan_is_inert(self):
+        assert not NULL_PLAN.enabled
+        assert not NULL_PLAN.fire("io.read")
+        assert not NULL_PLAN.armed("io.read")
+        assert NULL_PLAN.stats() == {}
+
+    def test_env_resolution_and_use_plan(self, monkeypatch):
+        # unset env -> the null singleton (the disabled-mode fast path)
+        monkeypatch.delenv(faultinject.ENV_VAR, raising=False)
+        faultinject.set_default_plan(None)
+        assert faultinject.plan() is NULL_PLAN
+        # armed env -> a real plan
+        monkeypatch.setenv(faultinject.ENV_VAR, "io.read:1.0:0:1")
+        faultinject.set_default_plan(None)
+        p = faultinject.plan()
+        assert isinstance(p, FaultPlan) and p.armed("io.read")
+        # use_plan scopes an override and restores on exit
+        override = FaultPlan([FaultSpec("ckpt.load", 1.0, 0, 0)],
+                             registry=Registry())
+        with faultinject.use_plan(override):
+            assert faultinject.plan() is override
+        assert faultinject.plan() is p
+        faultinject.set_default_plan(None)  # leave no env plan cached
+
+    def test_plan_for_prefers_hparams(self, monkeypatch):
+        monkeypatch.delenv(faultinject.ENV_VAR, raising=False)
+        faultinject.set_default_plan(None)
+        hps = HParams(faults="etl.worker:1.0:0:1")
+        p = faultinject.plan_for(hps)
+        assert isinstance(p, FaultPlan) and p.armed("etl.worker")
+        # no per-job spec -> the process default
+        assert faultinject.plan_for(HParams()) is faultinject.plan()
+        assert faultinject.plan_for(None) is faultinject.plan()
+
+
+# -- HParams validation of the resilience fields ---------------------------
+
+class TestConfigValidation:
+    def test_faults_spec_validated(self):
+        HParams(faults="io.read:0.5:1").validate()  # valid
+        with pytest.raises(ValueError, match="unknown fault point"):
+            HParams(faults="no.such:0.5:1").validate()
+
+    def test_nan_fields(self):
+        HParams(nan_skip_steps=2, nan_max_rollbacks=1,
+                nan_lr_cut=0.5).validate()
+        with pytest.raises(ValueError):
+            HParams(nan_skip_steps=-1).validate()
+        with pytest.raises(ValueError, match="nan_lr_cut"):
+            HParams(nan_lr_cut=0.0).validate()
+        with pytest.raises(ValueError, match="nan_lr_cut"):
+            HParams(nan_lr_cut=1.5).validate()
+
+    def test_decode_deadline(self):
+        HParams(decode_deadline_secs=2.5).validate()
+        with pytest.raises(ValueError, match="decode_deadline_secs"):
+            HParams(decode_deadline_secs=-1.0).validate()

@@ -24,18 +24,23 @@ hparams for provenance.  Arrays are gathered to host before writing
 from __future__ import annotations
 
 import glob
+import hashlib
 import json
 import logging
 import os
 import re
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.resilience import faultinject
+from textsummarization_on_flink_tpu.resilience.errors import (
+    CheckpointCorruptError,
+)
 from textsummarization_on_flink_tpu.train import optim
 from textsummarization_on_flink_tpu.train.trainer import TrainState
 
@@ -46,6 +51,7 @@ PyTree = Any
 CKPT_PREFIX = "model.ckpt"
 INDEX_FILE = "checkpoint"  # latest-pointer file, tf.train.Saver protocol
 BEST_INDEX_FILE = "checkpoint_best"
+MANIFEST_SUFFIX = ".sum"  # checksum manifest sidecar (RESILIENCE.md)
 
 
 # --------------------------------------------------------------------------
@@ -124,17 +130,91 @@ def arrays_to_state(flat: Dict[str, np.ndarray]) -> TrainState:
 # Raw file IO
 # --------------------------------------------------------------------------
 
+def _file_sha256(path: str) -> Tuple[str, int]:
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+            size += len(block)
+    return h.hexdigest(), size
+
+
 def save_arrays(path: str, flat: Dict[str, np.ndarray]) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **flat)
+    # checksum manifest (RESILIENCE.md): hashed from the tmp file BEFORE
+    # publish, so a manifest can never describe a file it didn't see;
+    # published after the npz so readers either find a verifiable pair
+    # or (crash window) a checkpoint without a manifest — never a
+    # manifest for a missing/partial checkpoint
+    digest, size = _file_sha256(tmp)
+    try:
+        # an overwrite (e.g. training re-reaching a step after a NaN
+        # rollback) must not leave the OLD manifest describing the NEW
+        # bytes during the publish window — drop it first so readers see
+        # manifest-less (loadable unverified), never mismatched
+        os.remove(path + MANIFEST_SUFFIX)
+    except OSError:
+        pass
     os.replace(tmp, path)  # atomic publish; readers never see partial files
+    mtmp = path + MANIFEST_SUFFIX + ".tmp"
+    with open(mtmp, "w", encoding="utf-8") as f:
+        json.dump({"algo": "sha256", "hexdigest": digest, "bytes": size,
+                   "file": os.path.basename(path)}, f)
+    os.replace(mtmp, path + MANIFEST_SUFFIX)
 
 
 def load_arrays(path: str) -> Dict[str, np.ndarray]:
     with np.load(path, allow_pickle=False) as z:
         return {k: z[k] for k in z.files}
+
+
+def verify_manifest(path: str) -> bool:
+    """Check `path` against its checksum manifest.
+
+    Returns True when the manifest exists and matches, False when there
+    is no manifest (pre-manifest checkpoint: nothing to verify against).
+    Raises CheckpointCorruptError on a mismatch or unreadable manifest.
+    """
+    mpath = path + MANIFEST_SUFFIX
+    if not os.path.exists(mpath):
+        return False
+    try:
+        with open(mpath, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+        want = manifest["hexdigest"]
+        want_bytes = int(manifest.get("bytes", -1))
+    except (OSError, ValueError, KeyError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable checksum manifest {mpath}") from e
+    got, size = _file_sha256(path)
+    if got != want or (want_bytes >= 0 and size != want_bytes):
+        raise CheckpointCorruptError(
+            f"checkpoint {path} failed checksum verification "
+            f"(manifest {want[:12]}.../{want_bytes}B, "
+            f"file {got[:12]}.../{size}B)")
+    return True
+
+
+def load_arrays_verified(path: str,
+                         faults: Optional[Any] = None,
+                         ) -> Dict[str, np.ndarray]:
+    """Checksum-verify (when a manifest exists) then load.  A zip/npz
+    decode failure is normalized to CheckpointCorruptError so every
+    corruption class routes through the same fallback."""
+    plan = faults if faults is not None else faultinject.plan()
+    if plan.fire("ckpt.load"):
+        raise CheckpointCorruptError(f"injected ckpt.load fault for {path}")
+    verify_manifest(path)
+    try:
+        return load_arrays(path)
+    except (ValueError, OSError, KeyError) as e:
+        # manifest matched (or was absent) but the payload won't decode
+        raise CheckpointCorruptError(
+            f"checkpoint {path} failed to decode: {e}") from e
 
 
 def _write_index(directory: str, ckpt_path: str, index_file: str) -> None:
@@ -174,19 +254,59 @@ def _ckpt_step(path: str) -> Tuple[int, int]:
     return (int(m.group(1)), 1 if m.group(2) else 0)
 
 
+def checkpoint_candidates(directory: str, index_file: str = INDEX_FILE,
+                          ) -> List[str]:
+    """Checkpoint paths newest-first: the index-resolved latest, then
+    every on-disk sibling in descending step order (the corruption
+    fallback chain, RESILIENCE.md)."""
+    prefix = "bestmodel" if index_file == BEST_INDEX_FILE else CKPT_PREFIX
+    pattern = os.path.join(directory, f"{prefix}-*.npz")
+    found = sorted(glob.glob(pattern), key=_ckpt_step, reverse=True)
+    latest = latest_checkpoint(directory, index_file)
+    if latest is not None and latest in found:
+        found.remove(latest)
+        found.insert(0, latest)
+    elif latest is not None:
+        found.insert(0, latest)
+    return found
+
+
 def load_ckpt(directory: str, index_file: str = INDEX_FILE,
               max_retries: Optional[int] = None, retry_secs: float = 10.0,
+              faults: Optional[Any] = None,
               ) -> Tuple[str, Dict[str, np.ndarray]]:
-    """Load the latest checkpoint, retrying until one appears
-    (util.py:29-41: infinite 10s retry by default)."""
+    """Load the newest loadable checkpoint, retrying until one appears
+    (util.py:29-41: infinite 10s retry by default).
+
+    Resilience (ISSUE 2): each attempt walks the candidate chain newest
+    to oldest, checksum-verifying via the manifest — a corrupted latest
+    checkpoint falls back to the next-older one instead of crashing
+    (``resilience/ckpt_fallbacks_total``).  The wait loop itself is
+    observable: ``ckpt/load_retries_total`` counts sleeps and
+    ``ckpt/load_wait_seconds`` gauges the cumulative wait, so a decoder
+    stuck waiting on a trainer is visible rather than silent.
+    """
     attempt = 0
+    waited = 0.0
+    c_retries = obs.counter("ckpt/load_retries_total")
+    c_fallbacks = obs.counter("resilience/ckpt_fallbacks_total")
+    g_wait = obs.gauge("ckpt/load_wait_seconds")
     while True:
-        path = latest_checkpoint(directory, index_file)
-        if path is not None:
+        for i, path in enumerate(checkpoint_candidates(directory, index_file)):
             try:
-                return path, load_arrays(path)
-            except (OSError, ValueError) as e:
+                flat = load_arrays_verified(path, faults=faults)
+            except CheckpointCorruptError as e:
+                c_fallbacks.inc()
+                log.warning("checkpoint %s unusable (%s); falling back to "
+                            "the next-older checkpoint", path, e)
+                continue
+            except OSError as e:  # raced with retention cleanup
                 log.info("Failed to load checkpoint from %s: %s", path, e)
+                continue
+            if i > 0:
+                log.warning("loaded fallback checkpoint %s (newer "
+                            "candidates were corrupt)", path)
+            return path, flat
         attempt += 1
         if max_retries is not None and attempt > max_retries:
             raise FileNotFoundError(
@@ -194,7 +314,10 @@ def load_ckpt(directory: str, index_file: str = INDEX_FILE,
                 f"{max_retries} retries")
         log.info("Failed to load checkpoint from %s. Sleeping %.0f secs...",
                  directory, retry_secs)
+        c_retries.inc()
         time.sleep(retry_secs)
+        waited += retry_secs
+        g_wait.set(waited)
 
 
 # --------------------------------------------------------------------------
@@ -209,6 +332,14 @@ class Checkpointer:
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.hps = hps
+        # a per-job fault plan (hps.faults) is resolved ONCE so its RNG
+        # streams and fire budgets persist across restore() calls — a
+        # "fails exactly N times then heals" spec must not reset per
+        # call.  The process default stays dynamic (resolved per use) so
+        # TS_FAULTS / use_plan() contexts keep routing.
+        self._job_faults = (
+            faultinject.plan_for(hps)
+            if hps is not None and getattr(hps, "faults", "") else None)
         os.makedirs(directory, exist_ok=True)
         # the provenance sidecar is written on the first save(), not here:
         # consulting is_chief() would force JAX backend init inside a
@@ -267,15 +398,45 @@ class Checkpointer:
                 log.info("removed old checkpoint %s", old)
             except OSError:
                 pass
+            try:
+                os.remove(old + MANIFEST_SUFFIX)
+            except OSError:
+                pass
+
+    def _load_with_fallback(
+            self, reg: obs.Registry,
+    ) -> Tuple[Optional[str], Optional[Dict[str, np.ndarray]]]:
+        """(path, arrays) of the newest loadable checkpoint, checksum-
+        verified, falling back over corrupt candidates (RESILIENCE.md);
+        (None, None) when the directory holds no loadable checkpoint."""
+        faults = (self._job_faults if self._job_faults is not None
+                  else faultinject.plan())
+        for path in checkpoint_candidates(self.directory):
+            try:
+                return path, load_arrays_verified(path, faults=faults)
+            except (CheckpointCorruptError, OSError) as e:
+                reg.counter("resilience/ckpt_fallbacks_total").inc()
+                log.warning("checkpoint %s unusable (%s); falling back to "
+                            "the next-older checkpoint", path, e)
+        return None, None
 
     def restore(self, path: Optional[str] = None) -> Optional[TrainState]:
-        path = path or latest_checkpoint(self.directory)
-        if path is None:
-            return None
         reg = obs.registry_for(self.hps)
+        if path is None:
+            path, flat = self._load_with_fallback(reg)
+            if flat is None:
+                return None
+        else:
+            # explicit path: verification failure surfaces to the caller
+            # (they asked for THIS checkpoint, silently substituting
+            # another would be wrong)
+            flat = load_arrays_verified(
+                path,
+                faults=(self._job_faults if self._job_faults is not None
+                        else faultinject.plan()))
         t0 = time.perf_counter()
         with obs.spans.span(reg, "checkpoint/restore"):
-            state = arrays_to_state(load_arrays(path))
+            state = arrays_to_state(flat)
         reg.histogram("checkpoint/restore_seconds").observe(
             time.perf_counter() - t0)
         reg.counter("checkpoint/restores_total").inc()
@@ -305,6 +466,10 @@ class BestModelSaver:
             if o != path:
                 try:
                     os.remove(o)
+                except OSError:
+                    pass
+                try:
+                    os.remove(o + MANIFEST_SUFFIX)
                 except OSError:
                     pass
         log.info("saved best model (loss %.4f) to %s", running_avg_loss, path)

@@ -118,6 +118,36 @@ class HParams:
     # (historical behavior), k>1 buffers k records per flush (the
     # reference flushes every 100 steps, run_summarization.py:242-244)
     summary_flush_every: int = 1
+    # ---- resilience (RESILIENCE.md; ISSUE 2) ----
+    # fault-injection arming for THIS job: comma-separated
+    # "point:prob:seed[:max]" specs (same syntax as the process-wide
+    # TS_FAULTS env var; known points listed in resilience/faultinject.py).
+    # "" (the default) leaves the job on the env plan — and with TS_FAULTS
+    # also unset, every injection hook is a null-singleton no-op.
+    faults: str = ""
+    # Divergence recovery (train/trainer.py).  On a non-finite loss the
+    # watchdog first discards the offending dispatch and SKIPS up to
+    # nan_skip_steps consecutive batches (params revert to the pre-step
+    # state), then ROLLS BACK to the last good checkpoint — cutting the
+    # learning rate by nan_lr_cut per rollback — up to nan_max_rollbacks
+    # times, and only then raises NanLossError.  Both 0 (the default)
+    # keeps the reference's hard abort (train.py:107-108) and its exact
+    # windowed-watchdog cost; arming either pins a per-dispatch metrics
+    # sync and disables buffer donation (the pre-step state must survive
+    # the dispatch), so recovery is an explicit opt-in for long
+    # unattended runs.  Single-host, default-mesh only.
+    nan_skip_steps: int = 0
+    nan_max_rollbacks: int = 0
+    # multiplicative LR cut applied at each divergence rollback (0.5 =
+    # halve); must be in (0, 1]
+    nan_lr_cut: float = 0.5
+    # Per-request decode deadline in seconds (decode/decoder.py).  When
+    # > 0 each decode_batch gets a Deadline; once a full-beam latency
+    # estimate exists and the remaining budget cannot cover it, the
+    # decoder degrades beam search to greedy (beam_size=1) and tags the
+    # results degraded=True (counted in resilience/decode_degraded_total).
+    # 0 (default) = no deadline, never degrade.
+    decode_deadline_secs: float = 0.0
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score
@@ -258,6 +288,20 @@ class HParams:
         if self.summary_flush_every < 1:
             raise ValueError(f"summary_flush_every must be >= 1, got "
                              f"{self.summary_flush_every}")
+        if self.nan_skip_steps < 0 or self.nan_max_rollbacks < 0:
+            raise ValueError("nan_skip_steps/nan_max_rollbacks must be >= 0")
+        if not 0.0 < self.nan_lr_cut <= 1.0:
+            raise ValueError(
+                f"nan_lr_cut must be in (0, 1], got {self.nan_lr_cut}")
+        if self.decode_deadline_secs < 0:
+            raise ValueError(f"decode_deadline_secs must be >= 0, got "
+                             f"{self.decode_deadline_secs}")
+        if self.faults:
+            # parse for validation only (unknown points / bad probs fail
+            # here, at config time, not at the injection site)
+            from textsummarization_on_flink_tpu.resilience import faultinject
+
+            faultinject.parse(self.faults)
 
 
 def beam_chunk_from_env() -> int:

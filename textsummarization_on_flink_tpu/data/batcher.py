@@ -30,6 +30,8 @@ from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.data import chunks, oov as oov_lib
 from textsummarization_on_flink_tpu.data.batching import Batch, SummaryExample
 from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.resilience import faultinject
+from textsummarization_on_flink_tpu.resilience.errors import WorkerCrashError
 
 log = logging.getLogger(__name__)
 
@@ -40,7 +42,8 @@ class Batcher:
     def __init__(self, data_path: str, vocab: Vocab, hps: HParams,
                  single_pass: bool, decode_batch_mode: str = "repeat",
                  watch_interval: float = 60.0,
-                 example_source: Optional[Callable[[], Iterator[Tuple[str, ...]]]] = None):
+                 example_source: Optional[Callable[[], Iterator[Tuple[str, ...]]]] = None,
+                 max_worker_restarts: int = 3):
         """
         Args:
           data_path: chunk-file glob (ignored when example_source given).
@@ -49,6 +52,12 @@ class Batcher:
           example_source: optional zero-arg callable returning an iterator
             of (article, abstract) pairs or (uuid, article, abstract,
             reference) passthrough 4-tuples — the streaming-bridge hook.
+          max_worker_restarts: total crash-restart budget shared by ALL
+            producer threads (RESILIENCE.md).  A crashed worker restarts
+            in place (re-opening its source — upstream dedup, e.g.
+            ResilientSource, owns exactly-once) up to this many times;
+            the budget spent, the first error surfaces from next_batch()
+            as a typed WorkerCrashError.  0 restores fail-fast.
         """
         self._data_path = data_path
         self._vocab = vocab
@@ -57,6 +66,10 @@ class Batcher:
         self._decode_batch_mode = decode_batch_mode
         self._example_source = example_source
         self._watch_interval = watch_interval
+        self._faults = faultinject.plan_for(hps)
+        # worker-crash restart budget (shared across producer threads)
+        self._restarts_left = max(int(max_worker_restarts), 0)
+        self._restart_lock = threading.Lock()
 
         self._batch_queue: "queue.Queue[Batch]" = queue.Queue(self.BATCH_QUEUE_MAX)
         self._example_queue: "queue.Queue[SummaryExample]" = queue.Queue(
@@ -85,6 +98,7 @@ class Batcher:
         # skips, batches emitted, and output-queue fill — examples/sec is
         # the counter's derivative, which the exporter snapshot carries
         reg = obs.registry_for(hps)
+        self._c_restarts = reg.counter("resilience/etl_worker_restarts_total")
         self._c_examples = reg.counter("data/examples_total")
         self._c_empty = reg.counter("data/empty_articles_total")
         self._c_batches = reg.counter("data/batches_total")
@@ -118,11 +132,17 @@ class Batcher:
         return self._batch_queue.qsize()
 
     def raise_if_failed(self) -> None:
-        """Re-raise the first producer-thread failure in the consumer."""
+        """Re-raise the first terminal producer failure in the consumer.
+
+        Typed as WorkerCrashError (a RuntimeError subclass, so the
+        pre-existing "producer thread failed" handlers keep working): by
+        the time this fires, the shared restart budget is spent and the
+        underlying cause is chained."""
         err = self._fill_error
         if err is not None:
-            raise RuntimeError(
-                "batcher producer thread failed; see chained cause") from err
+            raise WorkerCrashError(
+                "batcher producer thread failed; see chained cause "
+                "(worker restart budget spent)") from err
 
     def next_batch(self) -> Optional[Batch]:
         """Next Batch, or None when a single_pass dataset is exhausted.
@@ -154,16 +174,39 @@ class Batcher:
                         return None
 
     # -- producers --
+    def _consume_restart(self) -> bool:
+        """Atomically take one unit of the shared restart budget."""
+        with self._restart_lock:
+            if self._restarts_left <= 0:
+                return False
+            self._restarts_left -= 1
+            return True
+
     def _run_producer(self, fn: Callable[[], None]) -> None:
-        """Thread body: run `fn`, recording the first failure for the
-        consumer instead of letting it vanish in a daemon thread."""
-        try:
-            fn()
-        except BaseException as e:  # noqa: BLE001 — must capture everything
-            with self._fill_error_lock:
-                if self._fill_error is None:
-                    self._fill_error = e
-            log.error("batcher producer thread failed: %r", e)
+        """Thread body: run `fn`; on a crash, restart IN PLACE against
+        the shared budget (RESILIENCE.md etl worker policy) — the thread
+        re-runs `fn` from scratch, re-opening its source — and once the
+        budget is spent record the failure for the consumer instead of
+        letting it vanish in a daemon thread."""
+        while True:
+            try:
+                fn()
+                return  # clean exit (single_pass exhaustion)
+            except BaseException as e:  # noqa: BLE001 — capture everything
+                # a terminal failure is already recorded: this crash is
+                # downstream fallout (e.g. a batch thread seeing the dead
+                # example queue) — don't burn budget on it
+                if self._fill_error is None and self._consume_restart():
+                    self._c_restarts.inc()
+                    log.warning(
+                        "batcher producer crashed (%r); restarting in "
+                        "place (%d restart(s) left)", e, self._restarts_left)
+                    continue
+                with self._fill_error_lock:
+                    if self._fill_error is None:
+                        self._fill_error = e
+                log.error("batcher producer thread failed: %r", e)
+                return
 
     def _text_pairs(self) -> Iterator[Tuple[str, ...]]:
         """Yields (article, abstract) or, from a streaming source,
@@ -184,6 +227,11 @@ class Batcher:
     def _fill_example_queue(self) -> None:
         gen = self._text_pairs()
         while True:
+            if self._faults.fire("etl.worker"):
+                # the natural crash class for an ETL worker: an unhandled
+                # error mid-loop, driven through the same restart path a
+                # real one would take
+                raise RuntimeError("injected etl.worker fault")
             try:
                 item = next(gen)
             except StopIteration:
@@ -210,20 +258,31 @@ class Batcher:
 
     def _get_example(self, timeout: Optional[float] = None) -> Optional[SummaryExample]:
         """example_queue.get that gives up once a single_pass read finished,
-        or after `timeout` seconds (None = wait indefinitely)."""
-        waited = 0.0
+        or after `timeout` seconds (None = wait indefinitely).
+
+        The budget is MEASURED elapsed time (time.monotonic), not a count
+        of nominal 0.2s poll intervals — under a slow/contended queue a
+        get(timeout=0.2) can block far longer than 0.2s, and the old
+        interval count let `timeout=` stretch unboundedly (ISSUE 2
+        satellite: timeout accounting).
+        """
+        deadline = (None if timeout is None
+                    else time.monotonic() + max(timeout, 0.0))
         while True:
+            poll = 0.2
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                poll = min(poll, remaining)
             try:
-                return self._example_queue.get(timeout=0.2)
+                return self._example_queue.get(timeout=poll)
             except queue.Empty:
                 if self._fill_error is not None:
                     # an example thread died; propagate so this batch
                     # thread exits too instead of waiting forever
                     raise RuntimeError("example producer thread failed")
                 if self._single_pass and self._finished_reading:
-                    return None
-                waited += 0.2
-                if timeout is not None and waited >= timeout:
                     return None
 
     def _put_batch(self, batch: Batch) -> None:

@@ -39,6 +39,7 @@ from textsummarization_on_flink_tpu.data.batching import Batch
 from textsummarization_on_flink_tpu.data.vocab import STOP_DECODING, Vocab
 from textsummarization_on_flink_tpu.decode import beam_search
 from textsummarization_on_flink_tpu.evaluate import rouge
+from textsummarization_on_flink_tpu.resilience.policy import Deadline
 
 log = logging.getLogger(__name__)
 
@@ -83,7 +84,8 @@ class DecodedResult:
     def __init__(self, uuid: str, article: str, decoded_words: List[str],
                  reference: str, abstract_sents: List[str],
                  attn_dists: Optional[np.ndarray] = None,
-                 p_gens: Optional[np.ndarray] = None):
+                 p_gens: Optional[np.ndarray] = None,
+                 degraded: bool = False):
         self.uuid = uuid
         self.article = article
         self.decoded_words = decoded_words
@@ -91,6 +93,9 @@ class DecodedResult:
         self.abstract_sents = abstract_sents
         self.attn_dists = attn_dists
         self.p_gens = p_gens
+        # True when the decode deadline forced beam search down to greedy
+        # (RESILIENCE.md graceful degradation; hps.decode_deadline_secs)
+        self.degraded = degraded
 
     @property
     def decoded_sents(self) -> List[str]:
@@ -138,6 +143,20 @@ class BeamSearchDecoder:
         self._c_tokens = self._obs.counter("decode/tokens_total")
         self._c_busy = self._obs.counter("decode/busy_seconds_total")
         self._c_reloads = self._obs.counter("decode/ckpt_reloads_total")
+        # resilience (RESILIENCE.md): per-request Deadline + graceful
+        # degradation.  `_beam_secs` is an EMA of observed FULL-BEAM
+        # dispatch latency; once it exists and a request's remaining
+        # budget cannot cover it, the dispatch runs greedy (beam_size=1)
+        # and its results are tagged degraded=True.
+        self._c_degraded = self._obs.counter(
+            "resilience/decode_degraded_total")
+        self._g_beam_est = self._obs.gauge(
+            "resilience/decode_beam_latency_est_seconds")
+        self._beam_secs: Optional[float] = None
+        # the FIRST full-beam dispatch carries the jit compile (seconds
+        # to minutes); recording it would lock every later request into
+        # greedy, so the EMA only starts at the second full-beam dispatch
+        self._beam_warm = False
         self._params = params
         if params is None:
             self._load_params()
@@ -195,17 +214,61 @@ class BeamSearchDecoder:
         return time.time()
 
     # -- decoding --
-    def decode_batch(self, batch: Batch) -> List[DecodedResult]:
+    def _should_degrade(self, deadline: Deadline) -> bool:
+        """True when the remaining request budget cannot cover a
+        full-beam dispatch (RESILIENCE.md degradation contract).
+
+        Requires a latency estimate from a completed full-beam dispatch
+        AFTER the compile-inclusive first one — early requests are never
+        degraded.  Single-host path
+        only: the sharded search is jit-built once for the mesh plan and
+        cannot swap beam width per request."""
+        return (deadline.bounded
+                and self._sharded_search is None
+                and self._hps.beam_size > 1
+                and self._beam_secs is not None
+                and deadline.remaining() < self._beam_secs)
+
+    def decode_batch(self, batch: Batch,
+                     deadline: Optional[Deadline] = None,
+                     ) -> List[DecodedResult]:
         """One device dispatch for the whole batch; returns one result per
         REAL input row (``batch.real_mask``).  Padding rows — beam
         repetition in decode 'repeat' mode (batcher.py:344-347) and
         trickle/tail padding — are tagged by the batcher and dropped here;
         two legitimately identical input rows each get a result, matching
-        the reference's one-result-per-record contract (decode.py:159-185)."""
+        the reference's one-result-per-record contract (decode.py:159-185).
+
+        Resilience: every call carries a Deadline — the caller's, or one
+        built from ``hps.decode_deadline_secs`` (0 = unbounded, never
+        degrade).  When the budget is short of the full-beam latency
+        estimate the dispatch degrades to greedy (beam_size=1); results
+        are tagged ``degraded=True`` and counted in
+        ``resilience/decode_degraded_total``."""
+        if deadline is None:
+            deadline = Deadline.after(
+                getattr(self._hps, "decode_deadline_secs", 0.0))
+        degraded = self._should_degrade(deadline)
         t0 = time.perf_counter()
         with obs.spans.span(self._obs, "decode/batch"):
-            results = self._decode_batch_inner(batch)
+            results = self._decode_batch_inner(batch, degraded=degraded)
         dt = time.perf_counter() - t0
+        if degraded:
+            for res in results:
+                res.degraded = True
+            self._c_degraded.inc(len(results))
+            log.warning("decode deadline short of full-beam estimate "
+                        "(%.3fs remaining < %.3fs est); degraded %d "
+                        "result(s) to greedy", deadline.remaining(),
+                        self._beam_secs, len(results))
+        elif not self._beam_warm:
+            self._beam_warm = True  # compile-inclusive sample: discard
+        else:
+            # EMA of full-beam dispatch latency (greedy dispatches and
+            # compile times must not poison the estimate)
+            self._beam_secs = (dt if self._beam_secs is None
+                               else 0.7 * self._beam_secs + 0.3 * dt)
+            self._g_beam_est.set(self._beam_secs)
         self._c_busy.inc(dt)
         # requests in a batch share one dispatch: the batch wall time IS
         # each request's observed latency
@@ -216,7 +279,8 @@ class BeamSearchDecoder:
         self._c_beams.inc(len(results))
         return results
 
-    def _decode_batch_inner(self, batch: Batch) -> List[DecodedResult]:
+    def _decode_batch_inner(self, batch: Batch,
+                            degraded: bool = False) -> List[DecodedResult]:
         if self._sharded_search is not None:
             from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
 
@@ -227,7 +291,9 @@ class BeamSearchDecoder:
             out = beam_search.BeamSearchOutput(
                 *[np.asarray(x) for x in raw])
         else:
-            out = beam_search.run_beam_search(self._params, self._hps,
+            hps = (self._hps.replace(beam_size=1) if degraded
+                   else self._hps)
+            out = beam_search.run_beam_search(self._params, hps,
                                               batch.as_arrays())
         results: List[DecodedResult] = []
         for b in range(len(batch.original_articles)):

@@ -16,20 +16,52 @@ Rebuilds the reference's streaming I/O surface without the Flink runtime:
     per record: the reference's AI-Extended bridge only flushed a result
     when the NEXT record arrived (Integration Report Issue 6, :879-941);
     our sinks forward immediately by design.
+
+Resilience (ISSUE 2, RESILIENCE.md):
+  * stream sources never idle unbounded — ``settimeout(None)`` on a
+    long-lived socket let one dead peer hang the job forever; reads now
+    carry an ``idle_timeout`` and raise the typed ``StreamIdleError``;
+  * ``ResilientSource`` wraps any source factory (socket, Kafka,
+    iterator) with reconnect-with-backoff and uuid-keyed dedup, so a
+    flapping peer delivers every row exactly once downstream;
+  * ``BreakerSink`` wraps any sink with a circuit breaker: a down broker
+    sheds rows (counted) instead of blocking the pipeline;
+  * injection points ``io.connect`` / ``io.read`` / ``io.write`` drive
+    the chaos suite through these paths deterministically.
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import queue
 import socket as socket_lib
 import threading
+import time
 from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 
 from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.resilience import faultinject
+from textsummarization_on_flink_tpu.resilience.errors import StreamIdleError
+from textsummarization_on_flink_tpu.resilience.policy import (
+    CircuitBreaker,
+    RetryPolicy,
+)
 
 log = logging.getLogger(__name__)
+
+# the failure classes a reconnect can fix: connection/socket errors, the
+# typed idle timeout, and — when kafka-python is present — KafkaError,
+# which subclasses RuntimeError rather than OSError (NoBrokersAvailable
+# et al. must reconnect, not kill the job)
+try:  # pragma: no cover - optional dependency
+    from kafka.errors import KafkaError as _KafkaError
+
+    _RECONNECT_ERRORS: Tuple[type, ...] = (
+        OSError, StreamIdleError, _KafkaError)
+except ImportError:
+    _RECONNECT_ERRORS = (OSError, StreamIdleError)
 
 Row = Tuple[Any, ...]
 
@@ -176,25 +208,49 @@ class SocketSource(Source):
     max_count bounds the stream like MessageDeserializationSchema's record
     counter (:34-40) — the reference's hack to end a Kafka stream is a
     first-class bound here.
+
+    A long-lived stream read is NEVER left with ``settimeout(None)``
+    (the dead-peer hang, ISSUE 2 satellite 1): a connection that goes
+    ``idle_timeout`` seconds without delivering a byte raises the typed
+    ``StreamIdleError``.  Wrap in ``ResilientSource`` for
+    reconnect-with-backoff on top.
     """
 
     def __init__(self, host: str, port: int, max_count: int = 0,
-                 schema: Optional[RowSchema] = None, timeout: float = 30.0):
+                 schema: Optional[RowSchema] = None, timeout: float = 30.0,
+                 idle_timeout: float = 300.0):
         self._host = host
         self._port = port
         self._max = max_count
         self._timeout = timeout
+        self._idle_timeout = idle_timeout
+        self._faults = faultinject.plan()
         self.schema = schema or ARTICLE_INPUT_SCHEMA
 
     def rows(self) -> Iterator[Row]:
         n = 0
+        if self._faults.fire("io.connect"):
+            raise ConnectionRefusedError(
+                f"injected io.connect fault for {self._host}:{self._port}")
         with socket_lib.create_connection((self._host, self._port),
                                           timeout=self._timeout) as sock:
-            # the timeout governs CONNECT only; a long-lived stream may
-            # legitimately idle between records indefinitely
-            sock.settimeout(None)
+            # `timeout` governed CONNECT; from here the idle window
+            # bounds every read — a silent peer surfaces as a typed
+            # error instead of parking the source forever
+            sock.settimeout(self._idle_timeout or None)
             f = sock.makefile("r", encoding="utf-8")
-            for line in f:
+            while True:
+                if self._faults.fire("io.read"):
+                    raise ConnectionResetError(
+                        f"injected io.read fault after {n} row(s)")
+                try:
+                    line = f.readline()
+                except TimeoutError as e:  # socket.timeout alias (py3.10+)
+                    raise StreamIdleError(
+                        f"no data from {self._host}:{self._port} in "
+                        f"{self._idle_timeout:.0f}s (dead peer?)") from e
+                if not line:  # EOF: peer closed cleanly
+                    return
                 line = line.strip()
                 if not line:
                     continue
@@ -227,15 +283,26 @@ class IteratorSource(Source):
 
 class KafkaSource(Source):
     """Kafka topic consumer (App.java:134-143). Optional dependency: raises
-    a clear error at use time when kafka-python is unavailable."""
+    a clear error at use time when kafka-python is unavailable.
+
+    ``idle_timeout`` (seconds, 0 = wait forever — Kafka's default,
+    because a quiet topic is normal) bounds how long the consumer may
+    sit with no messages: an unbounded stream that idles past it raises
+    ``StreamIdleError`` (same contract as SocketSource) so a dead
+    broker/partition is a typed, retryable event — wrap in
+    ``ResilientSource`` for reconnect-with-backoff.
+    """
 
     def __init__(self, topic: str, bootstrap_servers: str = "localhost:9092",
                  group_id: str = "summarization", max_count: int = 0,
-                 schema: Optional[RowSchema] = None):
+                 schema: Optional[RowSchema] = None,
+                 idle_timeout: float = 0.0):
         self.topic = topic
         self.bootstrap_servers = bootstrap_servers
         self.group_id = group_id
         self._max = max_count
+        self._idle_timeout = idle_timeout
+        self._faults = faultinject.plan()
         self.schema = schema or ARTICLE_INPUT_SCHEMA
 
     def rows(self) -> Iterator[Row]:
@@ -245,22 +312,122 @@ class KafkaSource(Source):
             raise RuntimeError(
                 "KafkaSource needs the kafka-python package; use "
                 "CollectionSource/SocketSource or install kafka-python") from e
+        if self._faults.fire("io.connect"):
+            raise ConnectionRefusedError(
+                f"injected io.connect fault for {self.bootstrap_servers}")
+        kwargs = {}
+        if self._idle_timeout:
+            # kafka-python ends iteration (no exception) on this timeout;
+            # the tail check below turns that into the typed idle error
+            kwargs["consumer_timeout_ms"] = int(self._idle_timeout * 1000)
         consumer = KafkaConsumer(
             self.topic, bootstrap_servers=self.bootstrap_servers,
-            group_id=self.group_id, value_deserializer=lambda b: b)
+            group_id=self.group_id, value_deserializer=lambda b: b, **kwargs)
         n = 0
-        for msg in consumer:  # pragma: no cover - needs a broker
+        try:
+            for msg in consumer:  # pragma: no cover - needs a broker
+                if self._faults.fire("io.read"):
+                    raise ConnectionResetError(
+                        f"injected io.read fault after {n} row(s)")
+                try:
+                    row = Message.from_json(
+                        msg.value.decode("utf-8")).to_row()
+                except (ValueError, TypeError):
+                    obs.counter("pipeline/codec_errors_total").inc()
+                    log.warning("dropping malformed kafka message")
+                    continue
+                _count_source_row()
+                yield row
+                n += 1
+                if self._max and n >= self._max:
+                    return
+            if self._idle_timeout and not (self._max and n >= self._max):
+                # iteration ended on consumer_timeout_ms, not on the
+                # bound: the stream went idle
+                raise StreamIdleError(
+                    f"no kafka messages on {self.topic!r} in "
+                    f"{self._idle_timeout:.0f}s (dead broker/partition?)")
+        finally:
+            # an abandoned consumer lingers in its group until the
+            # session times out, forcing a rebalance per reconnect —
+            # leave the group promptly on ANY exit path
+            consumer.close()
+
+
+class ResilientSource(Source):
+    """Reconnect-with-backoff + exactly-once wrapper for any source.
+
+    ``factory`` builds a fresh inner source per (re)connection attempt
+    (construction must be cheap and side-effect free, which holds for
+    every source here: sockets/consumers open inside ``rows()``).  On a
+    connection-class failure — ``OSError`` (covers ConnectionError and
+    socket errors), ``StreamIdleError``, or (when kafka-python is
+    installed) ``KafkaError``, which subclasses RuntimeError rather than
+    OSError — the stream reconnects with decorrelated-jitter backoff up
+    to ``max_reconnects`` times, then surfaces ``RetriesExhaustedError``
+    with the last cause chained.
+
+    Exactly-once: a reconnected peer typically replays from its own
+    notion of the start (a socket server re-streams; a Kafka consumer
+    re-polls uncommitted offsets), so rows are deduped by their first
+    column (the Message uuid) before reaching the consumer; replayed
+    duplicates are counted in ``resilience/io_dup_rows_total``, and
+    reconnects in ``resilience/io_reconnects_total``.  Pass
+    ``dedup=False`` for schemas whose first column is not a unique key.
+    The dedup memory is BOUNDED: only the most recent ``dedup_window``
+    keys are held (FIFO eviction, default 65536) — the window only needs
+    to cover replay depth since the last reconnect, and an unbounded set
+    would leak on exactly the long-running streams this wrapper is for;
+    ``dedup_window=0`` keeps every key (short bounded streams).
+
+    ``seed``/``sleep`` pin the backoff for deterministic chaos tests.
+    """
+
+    def __init__(self, factory: Callable[[], Source],
+                 max_reconnects: int = 8, base_delay: float = 0.05,
+                 max_delay: float = 5.0, seed: Optional[int] = None,
+                 dedup: bool = True, dedup_window: int = 65536,
+                 schema: Optional[RowSchema] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._factory = factory
+        self._max_reconnects = max_reconnects
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._seed = seed
+        self._dedup = dedup
+        self._dedup_window = dedup_window
+        self._sleep = sleep
+        self._c_reconnects = obs.counter("resilience/io_reconnects_total")
+        self._c_dups = obs.counter("resilience/io_dup_rows_total")
+        self.schema = schema or factory().schema
+
+    def rows(self) -> Iterator[Row]:
+        policy = RetryPolicy(
+            max_attempts=self._max_reconnects + 1,
+            base_delay=self._base_delay, max_delay=self._max_delay,
+            seed=self._seed, name="io.source", sleep=self._sleep)
+        seen: "collections.OrderedDict[Any, None]" = collections.OrderedDict()
+        while True:
+            src = self._factory()
             try:
-                row = Message.from_json(msg.value.decode("utf-8")).to_row()
-            except (ValueError, TypeError):
-                obs.counter("pipeline/codec_errors_total").inc()
-                log.warning("dropping malformed kafka message")
-                continue
-            _count_source_row()
-            yield row
-            n += 1
-            if self._max and n >= self._max:
-                return
+                for row in src.rows():
+                    if self._dedup:
+                        key = row[0] if row else None
+                        if key in seen:
+                            self._c_dups.inc()
+                            continue
+                        seen[key] = None
+                        if self._dedup_window and len(seen) > self._dedup_window:
+                            seen.popitem(last=False)  # FIFO eviction
+                    yield row
+                return  # clean end of stream
+            except _RECONNECT_ERRORS as e:
+                policy.note_failure(e)  # raises when the budget is spent
+                self._c_reconnects.inc()
+                delay = policy.next_delay()
+                log.warning("stream source failed (%s); reconnecting in "
+                            "%.2fs", e, delay)
+                self._sleep(delay)
 
 
 # --------------------------------------------------------------------------
@@ -338,6 +505,60 @@ class KafkaSink(Sink):
     def close(self) -> None:  # pragma: no cover
         if self._producer is not None:
             self._producer.close()
+
+
+class BreakerSink(Sink):
+    """Circuit-breaker wrapper: a failing sink SHEDS rows instead of
+    blocking (or repeatedly stalling) the whole pipeline job.
+
+    Semantics (RESILIENCE.md "graceful degradation"): while the breaker
+    is closed, writes flow and failures are counted against it; after
+    ``threshold`` consecutive failures it opens and rows are dropped
+    immediately (``resilience/sink_shed_total``) for ``reset_secs``,
+    then a half-open probe write decides recovery.  Shedding loses data
+    BY DESIGN — a streaming job that blocks on a dead broker loses all
+    of it — and every loss is counted (``resilience/sink_errors_total``,
+    ``resilience/sink_shed_total``).  ``raise_on_error=True`` restores
+    fail-stop for pipelines that prefer crashing to shedding.
+
+    Injection point ``io.write`` fires inside the protected write.
+    """
+
+    def __init__(self, inner: Sink, breaker: Optional[CircuitBreaker] = None,
+                 raise_on_error: bool = False):
+        self._inner = inner
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            threshold=5, reset_secs=30.0, name="io.sink")
+        self._raise = raise_on_error
+        self._faults = faultinject.plan()
+        self._c_shed = obs.counter("resilience/sink_shed_total")
+        self._c_errors = obs.counter("resilience/sink_errors_total")
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self._breaker
+
+    def write(self, row: Row) -> None:
+        if not self._breaker.allow():
+            self._c_shed.inc()
+            return
+        try:
+            if self._faults.fire("io.write"):
+                raise ConnectionResetError("injected io.write fault")
+            self._inner.write(row)
+        except (OSError, RuntimeError) as e:
+            self._breaker.record_failure()
+            self._c_errors.inc()
+            self._c_shed.inc()
+            log.warning("sink write failed (%s); row shed "
+                        "(breaker %s)", e, self._breaker.state)
+            if self._raise:
+                raise
+        else:
+            self._breaker.record_success()
+
+    def close(self) -> None:
+        self._inner.close()
 
 
 class QueueSink(Sink):

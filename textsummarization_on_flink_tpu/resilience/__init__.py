@@ -1,0 +1,63 @@
+"""Resilience subsystem: retry/backoff, deadlines, circuit breaking,
+fault injection, and the typed failure vocabulary (ISSUE 2 tentpole).
+
+The reference system is a long-running streaming service (a Flink job
+training from one Kafka topic and serving another); transient faults —
+dead peers, corrupted checkpoints, NaN steps, crashed workers — must
+degrade gracefully instead of hanging or killing the job.  PR 1's obs/
+layer made failures *visible*; this package makes the system *survive*
+them.  See RESILIENCE.md for the policy inventory, injection-point
+names, gating, and degradation semantics.
+
+Wiring (each layer owns its policy, this package owns the primitives):
+
+  * train/trainer.py — NaN/Inf divergence recovery: skip, then roll back
+    to the last good checkpoint with an LR cut, then ``NanLossError``.
+  * checkpoint/checkpointer.py — checksum manifests on save, verify on
+    load, fall back to the next-older checkpoint on corruption.
+  * pipeline/io.py — stream idle timeouts (``StreamIdleError``),
+    reconnect-with-backoff sources, circuit-broken sinks.
+  * data/batcher.py — bounded worker-crash restart budget before a typed
+    ``WorkerCrashError``.
+  * decode/decoder.py — per-request ``Deadline``; beam search degrades
+    to greedy near the deadline, tagging the response degraded.
+
+Everything reports through ``resilience/*`` obs metrics; with
+``TS_FAULTS`` unset and default HParams every hook is a null-singleton
+no-op (same <2% overhead bar as obs/).  Import-light: no jax/numpy.
+"""
+
+from __future__ import annotations
+
+from textsummarization_on_flink_tpu.resilience.errors import (
+    CheckpointCorruptError,
+    CircuitOpenError,
+    DeadlineExceededError,
+    ResilienceError,
+    RetriesExhaustedError,
+    StreamIdleError,
+    WorkerCrashError,
+)
+from textsummarization_on_flink_tpu.resilience.faultinject import (
+    FaultPlan,
+    FaultSpec,
+    NULL_PLAN,
+    parse as parse_faults,
+    plan,
+    plan_for,
+    set_default_plan,
+    use_plan,
+)
+from textsummarization_on_flink_tpu.resilience.policy import (
+    CircuitBreaker,
+    Deadline,
+    RetryPolicy,
+)
+
+__all__ = [
+    "CheckpointCorruptError", "CircuitBreaker", "CircuitOpenError",
+    "Deadline", "DeadlineExceededError", "FaultPlan", "FaultSpec",
+    "NULL_PLAN", "ResilienceError", "RetriesExhaustedError", "RetryPolicy",
+    "StreamIdleError", "WorkerCrashError", "parse_faults", "plan",
+    "plan_for", "set_default_plan", "use_plan",
+]

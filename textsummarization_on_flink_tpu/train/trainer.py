@@ -31,6 +31,7 @@ import numpy as np
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.models import get_family
+from textsummarization_on_flink_tpu.resilience import faultinject
 from textsummarization_on_flink_tpu.train import optim
 
 log = logging.getLogger(__name__)
@@ -213,6 +214,91 @@ class NonFiniteLossError(RuntimeError):
     """Raised by the NaN/Inf watchdog (train.py:107-108 parity)."""
 
 
+class NanLossError(NonFiniteLossError):
+    """Divergence recovery exhausted its budgets (RESILIENCE.md): the
+    watchdog skipped ``hps.nan_skip_steps`` batches and rolled back
+    ``hps.nan_max_rollbacks`` times, and the loss still went non-finite.
+    A ``NonFiniteLossError`` subclass so pre-existing watchdog handlers
+    keep working."""
+
+
+class _DivergenceRecovery:
+    """Armed NaN/Inf recovery state (hps.nan_skip_steps > 0 or
+    hps.nan_max_rollbacks > 0).
+
+    Recovery ladder on a non-finite dispatch:
+      1. SKIP — discard the dispatch (params revert to the pre-step
+         state; the trainer runs without buffer donation when armed, so
+         the reference is still live) and try the next batch, up to
+         ``nan_skip_steps`` consecutive skips; any finite dispatch
+         resets the budget.
+      2. ROLLBACK — restore the last good checkpoint (or, without a
+         checkpointer / before the first save, the host-side last-good
+         snapshot) and cut the LR by ``nan_lr_cut``; up to
+         ``nan_max_rollbacks`` times.
+      3. RAISE — ``NanLossError``.
+
+    Counters: ``resilience/train/nan_skips_total``,
+    ``resilience/train/rollbacks_total``; gauge
+    ``resilience/train/lr_scale``.
+    """
+
+    def __init__(self, hps: HParams, checkpointer: Any,
+                 registry: obs.Registry, initial_state: "TrainState"):
+        self.hps = hps
+        self.checkpointer = checkpointer
+        self.skips_left = hps.nan_skip_steps
+        self.rollbacks_left = hps.nan_max_rollbacks
+        self.lr_scale = 1.0
+        self._c_skips = registry.counter("resilience/train/nan_skips_total")
+        self._c_rollbacks = registry.counter(
+            "resilience/train/rollbacks_total")
+        self._g_lr_scale = registry.gauge("resilience/train/lr_scale")
+        self._g_lr_scale.set(1.0)
+        # rollback fallback when no checkpoint exists yet (the initial
+        # state is always good); refreshed only when there is no
+        # checkpointer to restore from, and then only every
+        # SNAPSHOT_EVERY good dispatches — a per-step device_get of the
+        # full state (params + optimizer moments) would serialize every
+        # dispatch, and rollback semantics only promise "a known-good
+        # earlier state", not the newest one
+        self.snapshot = jax.device_get(initial_state)
+        self._good_since_snapshot = 0
+
+    SNAPSHOT_EVERY = 10
+
+    def note_good(self, state: "TrainState") -> None:
+        self.skips_left = self.hps.nan_skip_steps  # consecutive budget
+        if self.checkpointer is None:
+            self._good_since_snapshot += 1
+            if self._good_since_snapshot >= self.SNAPSHOT_EVERY:
+                self.snapshot = jax.device_get(state)
+                self._good_since_snapshot = 0
+
+    def next_action(self) -> str:
+        if self.skips_left > 0:
+            return "skip"
+        if self.rollbacks_left > 0:
+            return "rollback"
+        return "raise"
+
+    def take_skip(self) -> None:
+        self.skips_left -= 1
+        self._c_skips.inc()
+
+    def take_rollback(self) -> "TrainState":
+        """Consume one rollback: cut the LR and return the state to
+        resume from (host-side leaves; the next dispatch re-transfers)."""
+        self.rollbacks_left -= 1
+        self.skips_left = self.hps.nan_skip_steps
+        self.lr_scale *= self.hps.nan_lr_cut
+        self._g_lr_scale.set(self.lr_scale)
+        self._c_rollbacks.inc()
+        restored = (self.checkpointer.restore()
+                    if self.checkpointer is not None else None)
+        return restored if restored is not None else self.snapshot
+
+
 class PrefetchError(RuntimeError):
     """The DevicePrefetcher's worker thread failed; the original cause
     is chained (``raise ... from``).  Typed so consumers can tell an
@@ -387,6 +473,26 @@ class Trainer:
         self._c_steps = self._obs.counter("train/steps_total")
         self._c_examples = self._obs.counter("train/examples_total")
         self._c_nan = self._obs.counter("train/nan_watchdog_total")
+        # resilience (RESILIENCE.md): the fault plan is resolved ONCE so
+        # the per-point RNG streams stay deterministic across the run;
+        # unarmed jobs hold the null singleton (fire() is `return False`)
+        self._faults = faultinject.plan_for(hps)
+        armed = hps.nan_skip_steps > 0 or hps.nan_max_rollbacks > 0
+        self._recovery: Optional[_DivergenceRecovery] = None
+        if armed:
+            if hps.dp * hps.tp * hps.sp > 1 or jax.process_count() > 1:
+                raise ValueError(
+                    "divergence recovery (nan_skip_steps/nan_max_rollbacks) "
+                    "is single-host, default-mesh only: a skip must revert "
+                    "to the pre-step state, which the sharded/multi-host "
+                    "collective step donates away")
+            if step_fn is not None:
+                raise ValueError(
+                    "divergence recovery requires the trainer-built train "
+                    "step (LR cuts rebuild it); drop the custom step_fn or "
+                    "disarm nan_skip_steps/nan_max_rollbacks")
+            self._recovery = _DivergenceRecovery(
+                hps, checkpointer, self._obs, self.state)
         self.writer = SummaryWriter(
             self.train_dir,
             flush_every=getattr(hps, "summary_flush_every", 1),
@@ -426,8 +532,20 @@ class Trainer:
                 step_fn = mesh_lib.make_sharded_train_step(
                     plan, state=self.state)
             else:
-                step_fn = jax.jit(make_train_step(hps), donate_argnums=0)
+                step_fn = self._build_step_fn()
         self._step_fn = step_fn
+
+    def _build_step_fn(self) -> Callable:
+        """The single-device jitted step.  Unarmed: donates the input
+        state (lowest memory).  Armed divergence recovery: NO donation —
+        a skip reverts to the pre-step state, so its buffers must
+        survive the dispatch — and the LR carries the rollback cut."""
+        hps = self.hps
+        if self._recovery is not None:
+            if self._recovery.lr_scale != 1.0:
+                hps = hps.replace(lr=hps.lr * self._recovery.lr_scale)
+            return jax.jit(make_train_step(hps))
+        return jax.jit(make_train_step(hps), donate_argnums=0)
 
     def train(self, num_steps: Optional[int] = None) -> TrainState:
         """Run until num_steps (hps.num_steps when None; 0 = until the
@@ -512,7 +630,9 @@ class Trainer:
                 return jax.lax.scan(
                     lambda s, arrays: step_fn(s, arrays), state, stacked)
 
-            fn = jax.jit(multi, donate_argnums=0)
+            # armed recovery: the pre-dispatch state must survive a skip
+            fn = (jax.jit(multi) if self._recovery is not None
+                  else jax.jit(multi, donate_argnums=0))
             self._multi_step_cache[k] = fn
         return fn
 
@@ -587,6 +707,39 @@ class Trainer:
         except Exception:  # the watchdog error must still propagate
             log.exception("failed to dump NaN batch")
 
+    def _recover(self, step: int) -> bool:
+        """Armed divergence handling for one non-finite dispatch.
+
+        Returns True when the run can continue (the offending dispatch
+        was discarded; ``self.state`` is the state to resume from) and
+        False when the skip AND rollback budgets are exhausted — the
+        caller raises NanLossError.
+        """
+        rec = self._recovery
+        action = rec.next_action()
+        if action == "skip":
+            rec.take_skip()
+            log.warning(
+                "non-finite loss at step %d: skipping the batch "
+                "(%d consecutive skips left before rollback)",
+                step, rec.skips_left)
+            return True
+        if action == "rollback":
+            restored = rec.take_rollback()
+            self.state = restored
+            # the LR cut changes the step function: rebuild and drop the
+            # multi-step cache (both re-jit; a rollback is rare enough
+            # that the recompile is noise)
+            self._step_fn = self._build_step_fn()
+            self._multi_step_cache.clear()
+            log.warning(
+                "non-finite loss at step %d: rolled back to step %d with "
+                "lr scale %.3g (%d rollbacks left)",
+                step, int(np.asarray(restored.step)), rec.lr_scale,
+                rec.rollbacks_left)
+            return True
+        return False
+
     def _train_steps(self, limit, last_ckpt, profile_dir, profile_start,
                      profile_stop, prefetcher, multihost) -> TrainState:
         profiling = False
@@ -651,7 +804,7 @@ class Trainer:
             try:
                 if n == 1:
                     _, arrays = items[0]
-                    self.state, metrics = self._step_fn(self.state, arrays)
+                    new_state, metrics = self._step_fn(self.state, arrays)
                 else:
                     # stack on device: k tiny int/float batch arrays gain
                     # a leading scan axis (bytes ~ k x the batch, trivial
@@ -659,7 +812,7 @@ class Trainer:
                     arrays = jax.tree_util.tree_map(
                         lambda *xs: jnp.stack(xs),
                         *[a for _, a in items])
-                    self.state, metrics = self._multi_step(n)(
+                    new_state, metrics = self._multi_step(n)(
                         self.state, arrays)
                     arrays = None
             except FloatingPointError as e:
@@ -668,9 +821,53 @@ class Trainer:
                 # offending batch and surface the watchdog error type
                 self._c_nan.inc()
                 self._dump_nan_batch(step, arrays)
+                if self._recovery is not None:
+                    # the step never completed, so self.state is still
+                    # the pre-dispatch state — skip/rollback from it
+                    if self._recover(step):
+                        step = int(np.asarray(self.state.step))
+                        continue
+                    raise NanLossError(
+                        f"Loss is not finite and divergence recovery is "
+                        f"exhausted. Stopping. (step {step}; "
+                        f"jax_debug_nans trace above)") from e
                 raise NonFiniteLossError(
                     f"Loss is not finite. Stopping. (step {step}; "
                     f"jax_debug_nans trace above)") from e
+            injected = self._faults.fire("train.step_nan")
+            if self._recovery is not None:
+                # armed: one D2H metrics sync per dispatch — poisoned
+                # state must never outlive the dispatch that made it (the
+                # documented cost of arming, config.py nan_skip_steps)
+                fetched = jax.device_get(metrics)
+                finite = bool(np.all(np.isfinite(np.asarray(fetched.loss))))
+                if injected or not finite:
+                    self._c_nan.inc()
+                    self._dump_nan_batch(step, arrays)
+                    # new_state is discarded; self.state (pre-dispatch,
+                    # never donated when armed) remains the live params
+                    if self._recover(step):
+                        step = int(np.asarray(self.state.step))
+                        continue
+                    raise NanLossError(
+                        f"Loss is not finite and divergence recovery is "
+                        f"exhausted. Stopping. (step {step}"
+                        f"{'; injected train.step_nan' if injected else ''})")
+                self.state = new_state
+                self._recovery.note_good(new_state)
+                metrics = fetched  # flush below reuses the fetched copy
+            else:
+                # the dispatch itself completed: publish its state BEFORE
+                # any injected raise, so self.state never points at
+                # buffers the donated step already consumed (an on-error
+                # handler may still save it)
+                self.state = new_state
+                if injected:
+                    self._c_nan.inc()
+                    raise NonFiniteLossError(
+                        f"injected train.step_nan fault at step {step} "
+                        f"(divergence recovery unarmed: nan_skip_steps and "
+                        f"nan_max_rollbacks are 0)")
             pending.append((step, n, metrics,
                             arrays if self.hps.debug else None))
             prev_step = step
@@ -678,7 +875,7 @@ class Trainer:
             pending_steps += n
             self._c_steps.inc(n)
             self._c_examples.inc(n * self.hps.batch_size)
-            if pending_steps >= flush_every:
+            if pending_steps >= flush_every or self._recovery is not None:
                 self._flush_metrics(pending, time.time() - window_t0)
                 pending = []
                 pending_steps = 0
