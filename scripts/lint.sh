@@ -1,21 +1,27 @@
 #!/usr/bin/env bash
 # Fast lint gate (wired into scripts/repro.sh ahead of the full suite).
+# Two stages, split by responsibility (see ruff.toml header + ANALYSIS.md):
 #
-# Uses ruff (config: ruff.toml) when the rig has it; this container
-# bakes its toolchain and forbids network installs, so absent ruff the
-# gate degrades to a compileall syntax sweep — it still catches the
-# syntax-error class before the test tier spends minutes importing.
+#   1. ruff E/F/W — generic syntax/pyflakes class.  The rig may lack
+#      ruff (this container bakes its toolchain and forbids network
+#      installs), so absent ruff the stage degrades to a compileall
+#      syntax sweep — it still catches the syntax-error class before
+#      the test tier spends minutes importing.
+#   2. tools/tslint — the repo-native AST rules ruff cannot express
+#      (TS001 jit purity, TS002 host-sync-in-hot-loop, TS003 monotonic
+#      clock, TS004 lock discipline, TS005 broad-except, TS006 donation
+#      aliasing).  Stdlib-only, so it always runs; grandfathered
+#      findings live in tools/tslint/baseline.json.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if python -m ruff --version >/dev/null 2>&1; then
-  exec python -m ruff check .
+  python -m ruff check .
 elif command -v ruff >/dev/null 2>&1; then
-  exec ruff check .
-fi
-
-echo "[lint] ruff unavailable; running compileall syntax sweep instead"
-python - <<'EOF'
+  ruff check .
+else
+  echo "[lint] ruff unavailable; running compileall syntax sweep instead"
+  python - <<'EOF'
 import compileall
 import re
 import sys
@@ -24,3 +30,7 @@ ok = compileall.compile_dir(
     ".", quiet=1, rx=re.compile(r"\.git|\.jax_cache|exp/"), force=False)
 sys.exit(0 if ok else 1)
 EOF
+fi
+
+echo "[lint] tslint (repo-native AST rules, ANALYSIS.md)"
+python -m tools.tslint --baseline tools/tslint/baseline.json

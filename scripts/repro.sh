@@ -13,8 +13,15 @@ export PYTHONPATH="$PWD"
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== lint (ruff or compileall fallback)"
+echo "== lint (ruff or compileall fallback + tools/tslint AST rules)"
 bash scripts/lint.sh
+
+echo "== static analysis self-check (tslint JSON reporter + rule registry)"
+# lint.sh already ran the text-mode gate; exercise the reporter paths it
+# does NOT touch so a broken --format json / --list-rules fails repro
+python -m tools.tslint --baseline tools/tslint/baseline.json --format json \
+  > /dev/null
+python -m tools.tslint --list-rules > /dev/null
 
 echo "== telemetry smoke (obs registry/spans/exporters)"
 python -m pytest tests/test_obs*.py -q -p no:cacheprovider

@@ -95,7 +95,7 @@ def _loop_kind(kind: Optional[str] = None) -> str:
             # backend actually resolved (cheap after first init)
             try:
                 proxied = "axon" in jax.default_backend().lower()
-            except Exception:  # backend init failure: fall through
+            except Exception:  # tslint: disable=TS005 — ANY backend-init failure must fall through to the 'while' default, never break decode
                 pass
         kind = "scan" if proxied else "while"
         if not _loop_kind_logged.get(kind):
@@ -349,7 +349,7 @@ def run_beam_search(params, hps: HParams, arrays: Dict[str, np.ndarray],
     loop = _loop_kind()
     try:  # jit-cache growth across this call = a fresh trace/compile
         before = run_beam_search_jit._cache_size()
-    except Exception:  # private API; telemetry must never break decode
+    except Exception:  # tslint: disable=TS005 — _cache_size is a private jax API; telemetry must never break decode
         before = None
     out = run_beam_search_jit(params, hps, arrays, loop=loop,
                               chunk=resolved_chunk(loop))
@@ -361,6 +361,6 @@ def run_beam_search(params, hps: HParams, arrays: Dict[str, np.ndarray],
             obs.registry_for(hps).counter(
                 "decode/compile_cache_misses_total" if missed
                 else "decode/compile_cache_hits_total").inc()
-        except Exception:
+        except Exception:  # tslint: disable=TS005 — best-effort cache-hit telemetry; decode result already in hand
             pass
     return BeamSearchOutput(*[np.asarray(x) for x in out])

@@ -200,18 +200,22 @@ class BeamSearchDecoder:
         log.info("decoder loaded checkpoint %s", path)
 
     def maybe_reload_checkpoint(self, last_load: float) -> float:
-        """Continuous-serving checkpoint refresh (decode.py:149-157)."""
+        """Continuous-serving checkpoint refresh (decode.py:149-157).
+
+        ``last_load`` is a ``time.monotonic()`` reference: the 60s reload
+        cadence is a duration, and a wall-clock jump (NTP slew, suspend)
+        must neither storm reloads nor starve them (TS003)."""
         if self._train_dir is None:
             return last_load
-        if time.time() - last_load < SECS_UNTIL_NEW_CKPT:
+        if time.monotonic() - last_load < SECS_UNTIL_NEW_CKPT:
             return last_load
         latest = ckpt_lib.latest_checkpoint(self._train_dir)
         if latest is not None and latest != self._ckpt_path:
             log.info("Decoder has been decoding for %.0f seconds; loading "
-                     "new checkpoint", time.time() - last_load)
+                     "new checkpoint", time.monotonic() - last_load)
             self._load_params()
             self._c_reloads.inc()
-        return time.time()
+        return time.monotonic()
 
     # -- decoding --
     def _should_degrade(self, deadline: Deadline) -> bool:
@@ -336,7 +340,7 @@ class BeamSearchDecoder:
         serving path (pipeline transform) wants results through the sink
         only, not an unbounded per-record disk write.
         """
-        t_last = time.time()
+        t_last = time.monotonic()
         counter = 0
         n_batches = 0
         while True:
@@ -348,10 +352,10 @@ class BeamSearchDecoder:
                     break
                 log.info("batcher exhausted; stopping decode loop")
                 break
-            t0 = time.time()
+            t0 = time.monotonic()
             results = self.decode_batch(batch)
             log.info("decoded batch of %d article(s) in %.3f s",
-                     len(results), time.time() - t0)
+                     len(results), time.monotonic() - t0)
             for res in results:
                 if self._hps.single_pass:
                     self.write_for_rouge(res, counter)

@@ -208,7 +208,7 @@ def _use_flash(hps: HParams, T: int) -> bool:
     aligned = T % 128 == 0 and hd % 128 == 0
     try:
         on_tpu = jax.default_backend() == "tpu"
-    except Exception:  # pragma: no cover
+    except Exception:  # pragma: no cover - tslint: disable=TS005 — backend probe: any init failure means "not TPU"
         on_tpu = False
     if mode == "on":
         return on_tpu

@@ -93,7 +93,7 @@ class EventSink:
         if self._f is not None and not os.path.exists(self.path):
             try:
                 self._f.close()
-            except Exception:
+            except (OSError, ValueError):  # double-close on a dead handle
                 pass
             self._f = None
         for attempt in (0, 1):
@@ -106,7 +106,7 @@ class EventSink:
             except (OSError, ValueError):  # ValueError: closed file
                 try:
                     self._f.close()
-                except Exception:
+                except (OSError, ValueError):
                     pass
                 self._f = None
         # both attempts failed: count the loss, drop the batch
@@ -138,7 +138,7 @@ class EventSink:
         if self._f is not None:
             try:
                 self._f.close()
-            except Exception:
+            except (OSError, ValueError):  # flusher exit: best-effort close
                 pass
 
     def flush(self, timeout: float = 5.0) -> None:

@@ -96,6 +96,10 @@ class _SpanContext:
     def __enter__(self) -> "_SpanContext":
         stack = self._tracer._stack()
         stack.append(self.name)
+        # wall_start is SERIALIZED (the ts_us event timestamp, aligned
+        # across processes) — the one legitimate time.time() use (TS003
+        # exemption, ANALYSIS.md); durations NEVER derive from it: they
+        # come from the monotonic perf_counter below.
         self._wall0 = time.time()
         self._t0 = time.perf_counter()
         return self

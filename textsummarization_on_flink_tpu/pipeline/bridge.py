@@ -201,7 +201,7 @@ class NativeRecordQueue(RecordQueue):
             if getattr(self, "_handle", None):
                 self._lib.tsb_queue_free(self._handle)
                 self._handle = None
-        except Exception:  # pragma: no cover - interpreter teardown
+        except Exception:  # pragma: no cover - tslint: disable=TS005 — __del__ during interpreter teardown
             pass
 
 

@@ -185,6 +185,7 @@ class _BridgeFeeder:
                         return
         except BaseException as e:  # propagated via raise_if_failed
             self.error = e
+            self._reg.counter("pipeline/feeder_errors_total").inc()
             log.exception("source stream failed")
         finally:
             self._q.close()

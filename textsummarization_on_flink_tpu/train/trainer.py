@@ -188,7 +188,7 @@ class SummaryWriter:
                         "lost", self._unflushed)
         try:
             self._f.close()
-        except Exception:
+        except (OSError, ValueError):  # double-close / rotated-dir close
             pass
         self._f = None
         self._unflushed = 0
@@ -473,6 +473,7 @@ class Trainer:
         self._c_steps = self._obs.counter("train/steps_total")
         self._c_examples = self._obs.counter("train/examples_total")
         self._c_nan = self._obs.counter("train/nan_watchdog_total")
+        self._c_dump_errors = self._obs.counter("train/nan_dump_errors_total")
         # resilience (RESILIENCE.md): the fault plan is resolved ONCE so
         # the per-point RNG streams stay deterministic across the run;
         # unarmed jobs hold the null singleton (fire() is `return False`)
@@ -557,7 +558,9 @@ class Trainer:
         trace viewer.
         """
         limit = self.hps.num_steps if num_steps is None else num_steps
-        last_ckpt = time.time()
+        # checkpoint cadence is a DURATION: monotonic, never wall clock
+        # (TS003 — an NTP slew/suspend must not skip or double a save)
+        last_ckpt = time.monotonic()
         profile_dir = os.environ.get("TS_PROFILE_DIR")
         # anchor to the first step of THIS run (may resume past step 2)
         profile_start = int(self.state.step) + 2
@@ -705,6 +708,7 @@ class Trainer:
             log.error("non-finite loss at step %d; offending batch "
                       "dumped to %s", step, path)
         except Exception:  # the watchdog error must still propagate
+            self._c_dump_errors.inc()
             log.exception("failed to dump NaN batch")
 
     def _recover(self, step: int) -> bool:
@@ -754,7 +758,7 @@ class Trainer:
         # (--debug forces steps_per_dispatch=1, so arrays are per-step)
         pending = []  # [(first_step, n_steps, device_metrics, arrays)]
         pending_steps = 0
-        window_t0 = time.time()
+        window_t0 = time.monotonic()
         # ONE device sync to learn the resume step; from here the counter
         # is tracked host-side (+n per dispatch) so the loop never blocks
         # on state.step and dispatch can run ahead of the device
@@ -793,12 +797,12 @@ class Trainer:
                 break
             if profile_dir and not profiling and not profile_done \
                     and step >= profile_start:
-                self._flush_metrics(pending, time.time() - window_t0)
+                self._flush_metrics(pending, time.monotonic() - window_t0)
                 pending = []
                 pending_steps = 0
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
-                window_t0 = time.time()
+                window_t0 = time.monotonic()
                 log.info("profiler trace started -> %s", profile_dir)
             n = len(items)
             try:
@@ -825,7 +829,9 @@ class Trainer:
                     # the step never completed, so self.state is still
                     # the pre-dispatch state — skip/rollback from it
                     if self._recover(step):
-                        step = int(np.asarray(self.state.step))
+                        # recovery path, not the per-step path: one sync
+                        # to learn the resume step
+                        step = int(np.asarray(self.state.step))  # tslint: disable=TS002
                         continue
                     raise NanLossError(
                         f"Loss is not finite and divergence recovery is "
@@ -839,15 +845,15 @@ class Trainer:
                 # armed: one D2H metrics sync per dispatch — poisoned
                 # state must never outlive the dispatch that made it (the
                 # documented cost of arming, config.py nan_skip_steps)
-                fetched = jax.device_get(metrics)
-                finite = bool(np.all(np.isfinite(np.asarray(fetched.loss))))
+                fetched = jax.device_get(metrics)  # tslint: disable=TS002
+                finite = bool(np.all(np.isfinite(np.asarray(fetched.loss))))  # tslint: disable=TS002 — host data
                 if injected or not finite:
                     self._c_nan.inc()
                     self._dump_nan_batch(step, arrays)
                     # new_state is discarded; self.state (pre-dispatch,
                     # never donated when armed) remains the live params
                     if self._recover(step):
-                        step = int(np.asarray(self.state.step))
+                        step = int(np.asarray(self.state.step))  # tslint: disable=TS002
                         continue
                     raise NanLossError(
                         f"Loss is not finite and divergence recovery is "
@@ -876,10 +882,10 @@ class Trainer:
             self._c_steps.inc(n)
             self._c_examples.inc(n * self.hps.batch_size)
             if pending_steps >= flush_every or self._recovery is not None:
-                self._flush_metrics(pending, time.time() - window_t0)
+                self._flush_metrics(pending, time.monotonic() - window_t0)
                 pending = []
                 pending_steps = 0
-                window_t0 = time.time()
+                window_t0 = time.monotonic()
             if profiling and step > profile_stop:
                 jax.profiler.stop_trace()
                 profiling = False
@@ -893,17 +899,17 @@ class Trainer:
                     due = (step // checkpoint_steps
                            ) != (prev_step // checkpoint_steps)
                 else:
-                    due = time.time() - last_ckpt >= self.checkpoint_secs
+                    due = time.monotonic() - last_ckpt >= self.checkpoint_secs
                 if due:
                     # the save fetches state anyway; fold the metrics
                     # flush into the same sync point
-                    self._flush_metrics(pending, time.time() - window_t0)
+                    self._flush_metrics(pending, time.monotonic() - window_t0)
                     pending = []
                     pending_steps = 0
                     self.checkpointer.save(self.state)
-                    last_ckpt = time.time()
-                    window_t0 = time.time()
-        self._flush_metrics(pending, time.time() - window_t0)
+                    last_ckpt = time.monotonic()
+                    window_t0 = time.monotonic()
+        self._flush_metrics(pending, time.monotonic() - window_t0)
         if profiling:
             jax.profiler.stop_trace()
         if self.checkpointer is not None:
@@ -955,7 +961,7 @@ class Evaluator:
             batch = self.batcher.next_batch()
             if batch is None:
                 break
-            t0 = time.time()
+            t0 = time.monotonic()
             arrays = batch.as_arrays()
             if self._shard_batch is not None:
                 arrays = self._shard_batch(arrays)
@@ -969,9 +975,10 @@ class Evaluator:
                     self._mesh_plan, params=params)
             metrics = self._eval_fn(params, arrays)
             loss = float(metrics.total_loss if self.hps.coverage else metrics.loss)
-            self._m_eval_batch.observe(time.time() - t0)
+            self._m_eval_batch.observe(time.monotonic() - t0)
             self._c_eval_batches.inc()
-            log.info("seconds for eval batch: %.3f  loss: %f", time.time() - t0, loss)
+            log.info("seconds for eval batch: %.3f  loss: %f",
+                     time.monotonic() - t0, loss)
             if not np.isfinite(loss):
                 raise NonFiniteLossError("Eval loss is not finite.")
             self.running_avg_loss = calc_running_avg_loss(

@@ -1,0 +1,5 @@
+"""Repo-native developer tooling (no third-party dependencies).
+
+``tools/tslint`` is the static-analysis pass wired into
+``scripts/lint.sh`` / ``scripts/repro.sh`` (see ANALYSIS.md).
+"""

@@ -1,0 +1,24 @@
+"""tslint: repo-native static analysis for the failure classes ruff's
+E/F/W set cannot see (ANALYSIS.md).
+
+Rules: TS001 jit-purity, TS002 host-sync-in-hot-loop, TS003
+monotonic-clock, TS004 lock-discipline, TS005 broad-except, TS006
+donation-aliasing.  Stdlib-only (``ast``): no third-party dependency,
+same no-network constraint as scripts/lint.sh.
+
+API:
+    from tools.tslint import analyze            # engine entry
+    python -m tools.tslint --baseline tools/tslint/baseline.json
+"""
+
+from tools.tslint.engine import (  # noqa: F401
+    AnalysisResult,
+    Finding,
+    analyze,
+    load_baseline,
+    match_baseline,
+    write_baseline,
+)
+from tools.tslint.rules import RULES  # noqa: F401
+
+__version__ = "1.0"

@@ -1,0 +1,8 @@
+"""``python -m tools.tslint`` entry point."""
+
+import sys
+
+from tools.tslint.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

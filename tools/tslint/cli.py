@@ -1,0 +1,132 @@
+"""tslint command line.
+
+    python -m tools.tslint                          # scan the package
+    python -m tools.tslint path/to/file.py          # scan specific paths
+    python -m tools.tslint --baseline tools/tslint/baseline.json
+    python -m tools.tslint --write-baseline         # regenerate baseline
+    python -m tools.tslint --format json
+    python -m tools.tslint --select TS003,TS005
+    python -m tools.tslint --list-rules
+
+Exit codes: 0 clean (every finding baselined/suppressed), 1 new
+findings, 2 usage/internal error — the same contract ruff gives
+scripts/lint.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from tools.tslint import engine
+from tools.tslint.config import DEFAULT_BASELINE, DEFAULT_PATHS
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.tslint",
+        description="Repo-native static analysis: JAX purity, host-sync, "
+                    "clock, and lock discipline (ANALYSIS.md).")
+    p.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                   help=f"files/directories to scan (default: "
+                        f"{' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--root", default=None,
+                   help="repo root paths are resolved against (default: cwd)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON of grandfathered findings (default: "
+                        f"{DEFAULT_BASELINE} when it exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline, report every finding")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate the baseline from the current findings "
+                        "and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule subset, e.g. TS003,TS005")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        from tools.tslint.rules import RULES
+
+        for r in RULES:
+            print(f"{r.id}  {r.name:<22} {r.summary}")
+        return 0
+
+    root = os.path.abspath(args.root or os.getcwd())
+    select = ({s.strip().upper() for s in args.select.split(",") if s.strip()}
+              if args.select else None)
+    try:
+        result = engine.analyze(args.paths, root=root, select=select)
+    except FileNotFoundError as e:
+        print(f"tslint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        cand = os.path.join(root, DEFAULT_BASELINE)
+        if os.path.exists(cand):
+            baseline_path = cand
+    elif baseline_path is not None:
+        if not os.path.isabs(baseline_path):
+            baseline_path = os.path.join(root, baseline_path)
+        if not args.write_baseline and not os.path.exists(baseline_path):
+            # an explicit baseline that is missing must be a loud usage
+            # error, not a silent no-baseline run (the gate would then
+            # report grandfathered findings as new — or worse, pass
+            # while the operator believes the baseline was checked)
+            print(f"tslint: baseline not found: {baseline_path} "
+                  f"(generate it with --write-baseline)", file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        out = baseline_path or os.path.join(root, DEFAULT_BASELINE)
+        engine.write_baseline(result.findings, out)
+        print(f"tslint: wrote {len(result.findings)} finding(s) to "
+              f"{os.path.relpath(out, root)}")
+        return 0
+
+    baselined = 0
+    stale: list = []
+    new = result.findings
+    if baseline_path and os.path.exists(baseline_path) \
+            and not args.no_baseline:
+        try:
+            baseline = engine.load_baseline(baseline_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"tslint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        new, baselined, stale = engine.match_baseline(result.findings,
+                                                      baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "files": result.files,
+            "new": [f.as_json() for f in new],
+            "baselined": baselined,
+            "suppressed": result.suppressed,
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format_text())
+        for e in stale:
+            print(f"tslint: stale baseline entry (fixed? regenerate with "
+                  f"--write-baseline): {e['rule']} {e['path']} "
+                  f"[{e.get('scope', '?')}]", file=sys.stderr)
+        summary = (f"tslint: {result.files} file(s), "
+                   f"{len(new)} new finding(s), {baselined} baselined, "
+                   f"{result.suppressed} suppressed inline")
+        print(summary, file=sys.stderr if new else sys.stdout)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

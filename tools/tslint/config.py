@@ -1,0 +1,77 @@
+"""tslint configuration: per-rule options + deep merge.
+
+The defaults are tuned to THIS repo (the hot-function list names the
+train/decode/input loops whose per-step host syncs erase kernel wins —
+see ANALYSIS.md for why each entry is hot).  Tests and other checkouts
+override by passing a partial config dict to ``engine.analyze`` — it is
+deep-merged over these defaults, so overriding one rule key keeps the
+rest.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional
+
+#: Default scan target for the CLI when no paths are given.
+DEFAULT_PATHS = ("textsummarization_on_flink_tpu",)
+
+#: Default baseline location (relative to the scan root) the CLI picks
+#: up when --baseline is not given and the file exists.
+DEFAULT_BASELINE = "tools/tslint/baseline.json"
+
+DEFAULT: Dict[str, Any] = {
+    "exclude_dirs": {"__pycache__", ".git", ".jax_cache", "exp"},
+    "rules": {
+        "TS001": {
+            "enabled": True,
+            # dotted-call roots that are side effects at trace time: the
+            # call runs ONCE while jit traces and never again on device
+            "impure_roots": ["time", "os", "random", "logging", "log",
+                             "obs", "np.random"],
+            # sanctioned escape hatches (run on device / at runtime)
+            "allowed_prefixes": ["jax.debug"],
+        },
+        "TS002": {
+            "enabled": True,
+            # qualname regexes of per-step/per-token loops where one
+            # stray sync serializes dispatch (matched with re.search)
+            "hot_functions": [
+                r"^Trainer\._train_steps$",
+                r"^Evaluator\.run$",
+                r"^DevicePrefetcher\.next_batch$",
+                r"^Batcher\.next_batch$",
+                r"^BeamSearchDecoder\.decode$",
+            ],
+            # the sanctioned sync windows (metrics flush batches one D2H
+            # transfer per metrics_every steps by design)
+            "exempt_functions": [r"\._flush_metrics$", r"\._dump_nan_batch$"],
+        },
+        "TS003": {"enabled": True},
+        "TS004": {"enabled": True},
+        "TS005": {"enabled": True},
+        "TS006": {"enabled": True},
+    },
+}
+
+
+def merge_config(override: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """DEFAULT deep-merged with `override` (override wins per key; rule
+    dicts merge key-by-key rather than wholesale)."""
+    cfg = copy.deepcopy(DEFAULT)
+    if not override:
+        return cfg
+    for key, value in override.items():
+        if key == "rules" and isinstance(value, dict):
+            for rid, rcfg in value.items():
+                if isinstance(rcfg, dict):
+                    cfg["rules"].setdefault(rid, {}).update(rcfg)
+                elif isinstance(rcfg, bool):  # {"TS004": False} shorthand
+                    cfg["rules"].setdefault(rid, {})["enabled"] = rcfg
+                else:
+                    raise ValueError(
+                        f"rule config for {rid} must be a dict or bool, "
+                        f"got {type(rcfg).__name__}")
+        else:
+            cfg[key] = value
+    return cfg

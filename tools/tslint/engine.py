@@ -1,0 +1,277 @@
+"""tslint engine: file walking, scope annotation, inline suppression,
+baseline matching, and reporters.
+
+The engine is deliberately stdlib-only (``ast`` + ``json``): it mirrors
+``scripts/lint.sh``'s no-network constraint — the container bakes its
+toolchain, so the analyzer must run wherever ``python`` runs.
+
+Pipeline per file:
+  1. parse (a SyntaxError becomes a TS000 finding — the gate must not
+     crash on the exact broken file it exists to catch);
+  2. annotate every node with its enclosing qualname (``Class.method``)
+     and a parent pointer (rules use both);
+  3. run each enabled rule; ``FileContext.report`` drops findings whose
+     line carries ``# tslint: disable=<RULE>[,<RULE>...]`` (or
+     ``disable=all``) and records the suppression count;
+  4. match surviving findings against the baseline (a committed JSON
+     multiset of finding fingerprints — grandfathered debt, regenerated
+     with ``--write-baseline``).
+
+Fingerprints hash (rule, path, scope, source-line text), NOT line
+numbers, so unrelated edits above a grandfathered finding don't
+invalidate the baseline; moving or editing the offending line does.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.tslint.config import merge_config
+
+#: matches ``# tslint: disable=TS001`` / ``disable=TS001,TS004`` /
+#: ``disable=all``; the marker may share a comment with other markers
+#: (``# pragma: no cover - tslint: disable=TS005``), and anything after
+#: the rule list (a justification — which every suppression should
+#: carry) is ignored.
+SUPPRESS_RE = re.compile(
+    r"#.*?tslint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+PARSE_RULE = "TS000"  # synthetic rule id for unparseable files
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # root-relative posix path
+    line: int
+    col: int
+    message: str
+    scope: str  # enclosing qualname, "<module>" at top level
+    snippet: str  # stripped source text of the offending line
+
+    @property
+    def fingerprint(self) -> str:
+        key = "|".join((self.rule, self.path, self.scope, self.snippet))
+        return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
+
+    def format_text(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message} [in {self.scope}]")
+
+    def as_json(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint
+        return d
+
+
+def annotate_tree(tree: ast.AST) -> None:
+    """Attach ``_ts_scope`` (enclosing qualname; a def/class node's scope
+    includes its own name) and ``_ts_parent`` to every node."""
+    tree._ts_scope = ""  # type: ignore[attr-defined]
+    tree._ts_parent = None  # type: ignore[attr-defined]
+
+    def visit(node: ast.AST, scope: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child._ts_parent = node  # type: ignore[attr-defined]
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                child_scope = f"{scope}.{child.name}" if scope else child.name
+            else:
+                child_scope = scope
+            child._ts_scope = child_scope  # type: ignore[attr-defined]
+            visit(child, child_scope)
+
+    visit(tree, "")
+
+
+def walk_within(root: ast.AST, *, skip_defs: bool = True) -> Iterator[ast.AST]:
+    """Yield descendants of `root` without descending into nested
+    function/class/lambda bodies (the default) — rules that reason about
+    one scope's control flow must not leak into closures, which own their
+    own scope."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if skip_defs and isinstance(node, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class FileContext:
+    """One parsed file handed to every rule."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.AST,
+                 config: Dict[str, Any]):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.config = config
+        self.findings: List[Finding] = []
+        self.suppressed = 0
+        self._suppressions = self._parse_suppressions()
+        annotate_tree(tree)
+
+    def rule_config(self, rule_id: str) -> Dict[str, Any]:
+        return self.config.get("rules", {}).get(rule_id, {})
+
+    def _parse_suppressions(self) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                out[i] = {r.strip().upper() for r in m.group(1).split(",")}
+        return out
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rules = self._suppressions.get(line)
+        return rules is not None and (rule in rules or "ALL" in rules)
+
+    def report(self, rule: str, node: Optional[ast.AST], message: str,
+               line: Optional[int] = None, col: Optional[int] = None) -> None:
+        line = line if line is not None else getattr(node, "lineno", 1)
+        col = col if col is not None else getattr(node, "col_offset", 0)
+        if self.is_suppressed(rule, line):
+            self.suppressed += 1
+            return
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        scope = getattr(node, "_ts_scope", "") or "<module>"
+        self.findings.append(Finding(rule, self.relpath, line, col, message,
+                                     scope, snippet))
+
+
+# --------------------------------------------------------------------------
+# File discovery + analysis
+# --------------------------------------------------------------------------
+
+def _iter_py_files(paths: Sequence[str], root: str,
+                   exclude_dirs: Set[str]) -> Iterator[str]:
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            yield ap
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in exclude_dirs)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+        else:
+            raise FileNotFoundError(f"tslint: no such path: {p}")
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[Finding]
+    suppressed: int
+    files: int
+
+
+def analyze(paths: Sequence[str], root: Optional[str] = None,
+            config: Optional[Dict[str, Any]] = None,
+            select: Optional[Set[str]] = None) -> AnalysisResult:
+    """Run every enabled rule over `paths` (files or directories,
+    resolved against `root`, default cwd).  `select` restricts to a rule
+    subset; `config` is deep-merged over tools.tslint.config.DEFAULT."""
+    from tools.tslint import rules as rules_mod
+
+    root = os.path.abspath(root or os.getcwd())
+    cfg = merge_config(config)
+    exclude = set(cfg.get("exclude_dirs", ()))
+    findings: List[Finding] = []
+    suppressed = 0
+    nfiles = 0
+    for abspath in _iter_py_files(paths, root, exclude):
+        relpath = os.path.relpath(abspath, root).replace(os.sep, "/")
+        nfiles += 1
+        with open(abspath, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError as e:
+            findings.append(Finding(
+                PARSE_RULE, relpath, e.lineno or 1, e.offset or 0,
+                f"file does not parse: {e.msg}", "<module>",
+                (e.text or "").strip()))
+            continue
+        ctx = FileContext(relpath, source, tree, cfg)
+        for rule in rules_mod.RULES:
+            if select is not None and rule.id not in select:
+                continue
+            if not cfg.get("rules", {}).get(rule.id, {}).get("enabled", True):
+                continue
+            rule.check(ctx)
+        findings.extend(ctx.findings)
+        suppressed += ctx.suppressed
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return AnalysisResult(findings=findings, suppressed=suppressed,
+                          files=nfiles)
+
+
+# --------------------------------------------------------------------------
+# Baseline
+# --------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"malformed baseline file: {path}")
+    return data
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> None:
+    entries = [{
+        "fingerprint": f.fingerprint,
+        "rule": f.rule,
+        "path": f.path,
+        "scope": f.scope,
+        "snippet": f.snippet,
+        "message": f.message,
+        "line": f.line,  # informational only — matching is by fingerprint
+    } for f in findings]
+    payload = {"version": 1, "tool": "tslint", "findings": entries}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def match_baseline(findings: Sequence[Finding], baseline: Dict[str, Any],
+                   ) -> Tuple[List[Finding], int, List[Dict[str, Any]]]:
+    """Split findings into (new, baselined_count, stale_entries).
+    Matching is a multiset over fingerprints: N identical grandfathered
+    findings absorb at most N live ones; entries no live finding matched
+    are reported stale so the baseline shrinks as debt is paid."""
+    counts: collections.Counter = collections.Counter(
+        e["fingerprint"] for e in baseline.get("findings", ()))
+    used: collections.Counter = collections.Counter()
+    new: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint
+        if used[fp] < counts.get(fp, 0):
+            used[fp] += 1
+        else:
+            new.append(f)
+    stale: List[Dict[str, Any]] = []
+    remaining = collections.Counter(
+        {fp: c - used[fp] for fp, c in counts.items() if c > used[fp]})
+    for e in baseline.get("findings", ()):
+        fp = e["fingerprint"]
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            stale.append(e)
+    baselined = sum(used.values())
+    return new, baselined, stale

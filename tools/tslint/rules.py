@@ -1,0 +1,623 @@
+"""The six tslint rules (ANALYSIS.md documents each failure mode).
+
+| Rule  | Catches |
+|-------|---------|
+| TS001 | Python side effects inside jit-traced functions (run at trace
+|       | time only, silently absent from the compiled step)
+| TS002 | blocking device->host syncs inside declared hot loops
+| TS003 | durations computed from the jumpable wall clock (time.time())
+| TS004 | writes to lock-protected attributes outside the lock
+| TS005 | `except Exception` that swallows (no re-raise, no typed
+|       | mapping, no obs error counter)
+| TS006 | a buffer-donated argument referenced after the jitted call
+|       | (the buffer is dead — reads return garbage or crash)
+
+Every rule is a pure function over one ``engine.FileContext``; rules
+never import the analyzed code (AST only), so they are safe on files
+that would crash on import.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.tslint.engine import FileContext, walk_within
+
+
+def _dotted(node: Optional[ast.AST]) -> Optional[str]:
+    """'jax.lax.scan' for Attribute chains, 'x' for Names, else None
+    (any Subscript/Call in the chain breaks it — by design: `a.at[i].set`
+    must not read as a dotted name rooted at `a`)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _defs(tree: ast.AST) -> List[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _assign_targets(node: ast.AST) -> List[ast.AST]:
+    if isinstance(node, ast.Assign):
+        out: List[ast.AST] = []
+        for t in node.targets:
+            out.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+        return out
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        return [node.target]
+    return []
+
+
+def _prefix_match(dotted: str, roots: Sequence[str]) -> bool:
+    return any(dotted == r or dotted.startswith(r + ".") for r in roots)
+
+
+# --------------------------------------------------------------------------
+# TS001 — jit purity
+# --------------------------------------------------------------------------
+
+_JIT_WRAPPERS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_TRACE_SINKS = _JIT_WRAPPERS | {
+    "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.grad", "jax.value_and_grad",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.map", "lax.map",
+    "jax.checkpoint", "jax.remat",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+    "pl.pallas_call", "pallas_call",
+}
+_PARTIAL = {"functools.partial", "partial"}
+_IMPURE_BUILTINS = {"print", "input", "breakpoint", "open"}
+_METRIC_MUTATORS = {"inc", "dec", "observe", "set"}
+
+
+def _traced_defs(ctx: FileContext) -> Set[ast.AST]:
+    """Function/lambda nodes whose bodies run under a JAX trace: jit/pjit
+    decorated (incl. functools.partial(jax.jit, ...)), passed by name to
+    a trace sink (jit, vmap, grad, lax.scan/while_loop/cond, shard_map,
+    pallas_call — possibly through a functools.partial alias), returned
+    by a local factory whose call is handed to a sink
+    (``jax.jit(make_train_step(hps))``), or lexically nested in any of
+    those."""
+    tree = ctx.tree
+    defs = _defs(tree)
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for d in defs:
+        defs_by_name.setdefault(d.name, []).append(d)
+
+    # factory name -> local defs it returns (``def make(): def f(): ...;
+    # return f``) — jitting the factory's RESULT traces those defs
+    factory_returns: Dict[str, List[ast.AST]] = {}
+    for d in defs:
+        nested = {n.name: n for n in ast.walk(d)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n is not d}
+        for r in ast.walk(d):
+            if isinstance(r, ast.Return) and isinstance(r.value, ast.Name) \
+                    and r.value.id in nested:
+                factory_returns.setdefault(d.name, []).append(
+                    nested[r.value.id])
+
+    # x = functools.partial(f, ...)  ->  alias x -> f
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _dotted(node.value.func) in _PARTIAL \
+                and node.value.args \
+                and isinstance(node.value.args[0], ast.Name):
+            aliases[node.targets[0].id] = node.value.args[0].id
+
+    traced: Set[ast.AST] = set()
+
+    def mark_arg(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Name):
+            name = aliases.get(arg.id, arg.id)
+            traced.update(defs_by_name.get(name, ()))
+        elif isinstance(arg, ast.Lambda):
+            traced.add(arg)
+        elif isinstance(arg, ast.Call):
+            fd = _dotted(arg.func)
+            if fd in _PARTIAL and arg.args:
+                mark_arg(arg.args[0])
+            elif isinstance(arg.func, ast.Name) \
+                    and arg.func.id in factory_returns:
+                traced.update(factory_returns[arg.func.id])
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) in _TRACE_SINKS:
+            for a in node.args:
+                mark_arg(a)
+
+    for d in defs:
+        for dec in d.decorator_list:
+            dd = _dotted(dec)
+            if dd in _JIT_WRAPPERS:
+                traced.add(d)
+            elif isinstance(dec, ast.Call):
+                dfd = _dotted(dec.func)
+                if dfd in _JIT_WRAPPERS or (
+                        dfd in _PARTIAL and dec.args
+                        and _dotted(dec.args[0]) in _JIT_WRAPPERS):
+                    traced.add(d)
+
+    # nested defs/lambdas inside traced functions are traced too
+    for root in list(traced):
+        for node in ast.walk(root):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not root:
+                traced.add(node)
+    return traced
+
+
+def check_ts001(ctx: FileContext) -> None:
+    cfg = ctx.rule_config("TS001")
+    impure_roots = tuple(cfg.get("impure_roots", ()))
+    allowed = tuple(cfg.get("allowed_prefixes", ()))
+    traced = _traced_defs(ctx)
+    # report from root-most traced nodes only (avoids double reports on
+    # nested traced defs)
+    roots = [n for n in traced
+             if not any(a in traced for a in _ancestors(n))]
+    for root in roots:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                _ts001_call(ctx, node, impure_roots, allowed)
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for t in _assign_targets(node):
+                    td = _dotted(t)
+                    if td and (td == "self" or td.startswith("self.")):
+                        ctx.report(
+                            "TS001", node,
+                            f"mutation of {td!r} inside a jit-traced "
+                            f"function happens at trace time only (the "
+                            f"compiled step never re-runs it); return the "
+                            f"value instead")
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                ctx.report(
+                    "TS001", node,
+                    "global/nonlocal rebinding inside a jit-traced function "
+                    "is a trace-time side effect; thread state through "
+                    "arguments/returns")
+
+
+def _ancestors(node: ast.AST):
+    p = getattr(node, "_ts_parent", None)
+    while p is not None:
+        yield p
+        p = getattr(p, "_ts_parent", None)
+
+
+def _ts001_call(ctx: FileContext, node: ast.Call,
+                impure_roots: Tuple[str, ...],
+                allowed: Tuple[str, ...]) -> None:
+    if isinstance(node.func, ast.Name) and node.func.id in _IMPURE_BUILTINS:
+        ctx.report(
+            "TS001", node,
+            f"{node.func.id}() inside a jit-traced function runs at trace "
+            f"time only (use jax.debug.print for runtime output)")
+        return
+    fd = _dotted(node.func)
+    if fd:
+        if _prefix_match(fd, allowed):
+            return
+        if _prefix_match(fd, impure_roots):
+            ctx.report(
+                "TS001", node,
+                f"call to {fd}() inside a jit-traced function is a "
+                f"trace-time side effect (it will NOT run per step on "
+                f"device); hoist it out of the traced function")
+            return
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _METRIC_MUTATORS:
+        rec = _dotted(node.func.value)
+        if rec and (rec == "self" or rec.startswith("self.")):
+            ctx.report(
+                "TS001", node,
+                f"metric mutation {rec}.{node.func.attr}() inside a "
+                f"jit-traced function fires once at trace time, not per "
+                f"step; record metrics outside the traced function")
+
+
+# --------------------------------------------------------------------------
+# TS002 — host sync in hot loop
+# --------------------------------------------------------------------------
+
+_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready",
+               "np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_SYNC_METHODS = {"item", "block_until_ready"}
+_SYNC_CASTS = {"float", "int"}
+
+
+def check_ts002(ctx: FileContext) -> None:
+    cfg = ctx.rule_config("TS002")
+    hot = [re.compile(p) for p in cfg.get("hot_functions", ())]
+    exempt = [re.compile(p) for p in cfg.get("exempt_functions", ())]
+    for d in _defs(ctx.tree):
+        qn = getattr(d, "_ts_scope", d.name)
+        if not any(p.search(qn) for p in hot):
+            continue
+        if any(p.search(qn) for p in exempt):
+            continue
+        # one walk per function, loop membership decided by ancestry —
+        # a sync nested two loops deep is still ONE finding
+        for node in walk_within(d):
+            if isinstance(node, ast.Call) and _inside_loop(node, d):
+                _ts002_call(ctx, node)
+
+
+def _inside_loop(node: ast.AST, fn: ast.AST) -> bool:
+    for a in _ancestors(node):
+        if a is fn:
+            return False
+        if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+    return False
+
+
+def _ts002_call(ctx: FileContext, node: ast.Call) -> None:
+    fd = _dotted(node.func)
+    if fd in _SYNC_CALLS:
+        ctx.report(
+            "TS002", node,
+            f"{fd}() inside a hot loop is a blocking device->host sync "
+            f"that serializes dispatch; batch it into the metrics-flush "
+            f"window or move it off the per-step path")
+        return
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _SYNC_METHODS and not node.args:
+        ctx.report(
+            "TS002", node,
+            f".{node.func.attr}() inside a hot loop is a blocking "
+            f"device->host sync that serializes dispatch")
+        return
+    if isinstance(node.func, ast.Name) and node.func.id in _SYNC_CASTS \
+            and len(node.args) == 1 \
+            and isinstance(node.args[0], (ast.Name, ast.Attribute, ast.IfExp)):
+        ctx.report(
+            "TS002", node,
+            f"{node.func.id}(...) on a (likely device) value inside a hot "
+            f"loop forces a device->host sync; keep metrics on device and "
+            f"fetch them in a batched flush")
+
+
+# --------------------------------------------------------------------------
+# TS003 — monotonic clock for durations
+# --------------------------------------------------------------------------
+
+_WALL_CLOCKS = {"time.time"}
+
+
+def check_ts003(ctx: FileContext) -> None:
+    scopes: List[ast.AST] = [ctx.tree] + _defs(ctx.tree)
+    for scope in scopes:
+        wall_vars: Set[str] = set()
+        for node in walk_within(scope):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and _dotted(node.value.func) in _WALL_CLOCKS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        wall_vars.add(t.id)
+
+        def is_wall(n: ast.AST) -> bool:
+            if isinstance(n, ast.Call) and _dotted(n.func) in _WALL_CLOCKS:
+                return True
+            return isinstance(n, ast.Name) and n.id in wall_vars
+
+        for node in walk_within(scope):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                    and (is_wall(node.left) or is_wall(node.right)):
+                ctx.report(
+                    "TS003", node,
+                    "duration computed from the wall clock (time.time() "
+                    "jumps under NTP slew/suspend); use time.monotonic() — "
+                    "keep time.time() only for serialized epoch timestamps")
+
+
+# --------------------------------------------------------------------------
+# TS004 — lock discipline
+# --------------------------------------------------------------------------
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+_CONTAINER_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft", "popitem",
+    "remove", "discard", "clear", "update", "add", "setdefault", "sort",
+    "reverse",
+}
+_INIT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+@dataclasses.dataclass
+class _Mutation:
+    attr: str
+    node: ast.AST
+    in_lock: bool  # lexically inside `with self.<lock>:`
+
+
+def check_ts004(ctx: FileContext) -> None:
+    for cls in ast.walk(ctx.tree):
+        if isinstance(cls, ast.ClassDef):
+            _ts004_class(ctx, cls)
+
+
+def _ts004_class(ctx: FileContext, cls: ast.ClassDef) -> None:
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    lock_attrs: Set[str] = set()
+    for m in methods:
+        for node in walk_within(m):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                vd = _dotted(node.value.func) or ""
+                factory = vd.rsplit(".", 1)[-1] in _LOCK_FACTORIES
+                if not factory:
+                    continue
+                for t in node.targets:
+                    td = _dotted(t)
+                    if td and td.startswith("self.") and td.count(".") == 1:
+                        lock_attrs.add(td.split(".", 1)[1])
+    if not lock_attrs:
+        return
+
+    mutations: Dict[str, List[_Mutation]] = {}  # method name -> mutations
+    callsites: Dict[str, List[Tuple[str, bool]]] = {}  # callee -> (caller, in_lock)
+
+    def is_lock_cm(item: ast.withitem) -> bool:
+        d = _dotted(item.context_expr)
+        return bool(d and d.startswith("self.")
+                    and d.split(".", 1)[1] in lock_attrs)
+
+    def scan(node: ast.AST, mname: str, in_lock: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue  # nested scopes own their own discipline
+            child_lock = in_lock
+            if isinstance(child, (ast.With, ast.AsyncWith)) \
+                    and any(is_lock_cm(i) for i in child.items):
+                child_lock = True
+            if isinstance(child, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for t in _assign_targets(child):
+                    base = t
+                    if isinstance(base, ast.Subscript):
+                        base = base.value
+                    td = _dotted(base)
+                    if td and td.startswith("self.") and td.count(".") == 1:
+                        mutations.setdefault(mname, []).append(
+                            _Mutation(td.split(".", 1)[1], child, child_lock))
+            if isinstance(child, ast.Call):
+                fd = _dotted(child.func)
+                if fd and fd.startswith("self.") and fd.count(".") == 1:
+                    callsites.setdefault(fd.split(".", 1)[1], []).append(
+                        (mname, child_lock))
+                if isinstance(child.func, ast.Attribute) \
+                        and child.func.attr in _CONTAINER_MUTATORS:
+                    rd = _dotted(child.func.value)
+                    if rd and rd.startswith("self.") and rd.count(".") == 1:
+                        mutations.setdefault(mname, []).append(
+                            _Mutation(rd.split(".", 1)[1], child, child_lock))
+            scan(child, mname, child_lock)
+
+    for m in methods:
+        scan(m, m.name, False)
+
+    # fixpoint: a private helper whose EVERY intra-class call site holds
+    # the lock (lexically, or transitively through lock-held callers) is
+    # itself lock-held — `_set_state` called only under `with self._lock`
+    # is disciplined, not a finding
+    lock_held: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for m in methods:
+            if m.name in lock_held or m.name in _INIT_METHODS:
+                continue
+            sites = callsites.get(m.name)
+            if sites and all(il or caller in lock_held
+                             for caller, il in sites):
+                lock_held.add(m.name)
+                changed = True
+
+    def effective(mut_in_lock: bool, mname: str) -> bool:
+        return mut_in_lock or mname in lock_held
+
+    protected: Set[str] = set()
+    for mname, muts in mutations.items():
+        if mname in _INIT_METHODS:
+            continue
+        for mut in muts:
+            if effective(mut.in_lock, mname):
+                protected.add(mut.attr)
+    protected -= lock_attrs
+
+    for mname, muts in mutations.items():
+        if mname in _INIT_METHODS:
+            continue
+        for mut in muts:
+            if mut.attr in protected and not effective(mut.in_lock, mname):
+                ctx.report(
+                    "TS004", mut.node,
+                    f"attribute 'self.{mut.attr}' is written under a lock "
+                    f"elsewhere in {cls.name} but mutated here without "
+                    f"holding it (static race); take the lock or document "
+                    f"the single-writer invariant with a suppression")
+
+
+# --------------------------------------------------------------------------
+# TS005 — broad except without re-raise / typed mapping / obs counter
+# --------------------------------------------------------------------------
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def check_ts005(ctx: FileContext) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            broad = True
+        elif isinstance(node.type, ast.Tuple):
+            broad = any(_dotted(e) in _BROAD for e in node.type.elts)
+        else:
+            broad = _dotted(node.type) in _BROAD
+        if not broad:
+            continue
+        has_raise = False
+        has_counter = False
+        for stmt in node.body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Raise):
+                    has_raise = True
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "inc":
+                    has_counter = True
+        if not (has_raise or has_counter):
+            ctx.report(
+                "TS005", node,
+                "broad `except Exception` swallows the failure: re-raise, "
+                "map to a typed resilience.errors exception, or increment "
+                "an obs error counter (suppress inline with a one-line "
+                "justification if intentional)")
+
+
+# --------------------------------------------------------------------------
+# TS006 — donated buffer referenced after the jitted call
+# --------------------------------------------------------------------------
+
+def _donated_positions(call: ast.Call) -> Optional[List[int]]:
+    """[positions] when `call` is jax.jit/pjit with donate_argnums."""
+    if _dotted(call.func) not in _JIT_WRAPPERS:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = [e.value for e in v.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, int)]
+                return out or None
+    return None
+
+
+def check_ts006(ctx: FileContext) -> None:
+    scopes: List[ast.AST] = [ctx.tree] + _defs(ctx.tree)
+    for scope in scopes:
+        _ts006_scope(ctx, scope)
+
+
+def _ts006_scope(ctx: FileContext, scope: ast.AST) -> None:
+    donated: Dict[str, List[int]] = {}  # callable expr -> donated positions
+    for node in walk_within(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            td = _dotted(node.targets[0])
+            if not td:
+                continue
+            values = [node.value]
+            if isinstance(node.value, ast.IfExp):
+                values = [node.value.body, node.value.orelse]
+            for v in values:
+                if isinstance(v, ast.Call):
+                    pos = _donated_positions(v)
+                    if pos:
+                        donated[td] = sorted(set(donated.get(td, []) + pos))
+
+    # loads/stores of every dotted expr in this scope, in line order
+    loads: Dict[str, List[Tuple[int, ast.AST]]] = {}
+    stores: Dict[str, List[int]] = {}
+    for node in walk_within(scope):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            d = _dotted(node)
+            if d is None:
+                continue
+            if isinstance(getattr(node, "ctx", None), ast.Store):
+                stores.setdefault(d, []).append(node.lineno)
+            elif isinstance(getattr(node, "ctx", None), ast.Load):
+                loads.setdefault(d, []).append((node.lineno, node))
+
+    watches: List[Tuple[str, int, str]] = []  # (arg expr, call line, callee)
+    for node in walk_within(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        positions: Optional[List[int]] = None
+        callee = _dotted(node.func)
+        if callee and callee in donated:
+            positions = donated[callee]
+        elif isinstance(node.func, ast.Call):  # jax.jit(f, donate...)(x)
+            positions = _donated_positions(node.func)
+            callee = "jax.jit(...)"
+        if not positions:
+            continue
+        for i in positions:
+            if i < len(node.args):
+                ad = _dotted(node.args[i])
+                if ad:
+                    watches.append((ad, node.lineno, callee or "?"))
+
+    for expr, call_line, callee in watches:
+        uses = sorted(
+            ((ln, n) for d, entries in loads.items()
+             if d == expr or d.startswith(expr + ".")
+             for ln, n in entries if ln > call_line),
+            key=lambda t: t[0])
+        # >= call_line: `state = step(state, b)` rebinds on the call
+        # line itself — that store clears the watch
+        store_lines = sorted(ln for ln in stores.get(expr, ())
+                             if ln >= call_line)
+        for use_line, use_node in uses:
+            redefined = any(s <= use_line for s in store_lines)
+            if redefined:
+                break
+            ctx.report(
+                "TS006", use_node,
+                f"{expr!r} was donated to {callee} (its device buffer is "
+                f"consumed by the call) but is referenced again here; "
+                f"donated inputs are dead after dispatch — use the "
+                f"returned value or drop donate_argnums")
+            break
+
+
+# --------------------------------------------------------------------------
+# Registry
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    summary: str
+    check: Callable[[FileContext], None]
+
+
+RULES: Tuple[Rule, ...] = (
+    Rule("TS001", "jit-purity",
+         "Python side effects inside jit-traced functions run at trace "
+         "time only", check_ts001),
+    Rule("TS002", "host-sync-in-hot-loop",
+         "blocking device->host syncs inside declared hot loops serialize "
+         "dispatch", check_ts002),
+    Rule("TS003", "monotonic-clock",
+         "durations must use time.monotonic(), not the jumpable wall "
+         "clock", check_ts003),
+    Rule("TS004", "lock-discipline",
+         "lock-protected attributes must not be mutated outside the lock",
+         check_ts004),
+    Rule("TS005", "broad-except",
+         "except Exception must re-raise, map to a typed error, or count "
+         "the failure", check_ts005),
+    Rule("TS006", "donation-aliasing",
+         "donated jit arguments are dead after the call and must not be "
+         "referenced", check_ts006),
+)
