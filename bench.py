@@ -37,6 +37,13 @@ Modes (BENCH_MODE):
                     bucketing Batcher packing synthetic reference-scale
                     articles into static-shape batches (no TPU; compare
                     against the device's train samples/s).
+  serve           — concurrent serving (SERVING.md): BENCH_SERVE_REQS
+                    requests from BENCH_SERVE_CONCURRENCY submitter
+                    threads through ServingServer's admission queue +
+                    micro-batcher; p50/p99 END-TO-END latency (enqueue
+                    -> future resolved, queue wait included), mean
+                    batch fill, and requests/sec.  `python bench.py
+                    --serve` is shorthand for BENCH_MODE=serve.
 
 Env overrides: BENCH_STEPS (20), BENCH_BATCH (16),
 BENCH_PRESET=tiny|scaled (smoke scale / the BASELINE configs[3]
@@ -88,6 +95,7 @@ _METRIC_BY_MODE = {
     "attention": "attention_pallas_speedup_vs_xla",
     "flash": "flash_attention_speedup_vs_xla",
     "input": "input_pipeline_samples_per_sec",
+    "serve": "serve_e2e_p50_latency_ms",
 }
 
 
@@ -206,6 +214,17 @@ def _config_fingerprint() -> dict:
             fp["unroll"] = HParams.scan_unroll
     if mode == "trainer":
         fp["spd"] = int(os.environ.get("BENCH_SPD", "8"))
+    if mode == "serve":
+        fp["batch"] = int(os.environ.get("BENCH_BATCH", "4"))
+        fp["preset"] = os.environ.get("BENCH_PRESET", "ref") or "ref"
+        fp["family"] = (os.environ.get("BENCH_FAMILY", "")
+                        or "pointer_generator")
+        # the coalescing window trades latency for fill: rows measured
+        # under different windows must never cross-substitute
+        fp["wait_ms"] = float(os.environ.get("BENCH_SERVE_WAIT_MS", "20"))
+        fp["reqs"] = int(os.environ.get("BENCH_SERVE_REQS", "64"))
+        fp["concurrency"] = int(
+            os.environ.get("BENCH_SERVE_CONCURRENCY", "8"))
     if mode == "decode":
         # while vs scan vs chunked decode loops differ by ~1.4 ms per
         # dynamic iteration on the tunneled backend — never
@@ -1112,6 +1131,119 @@ def bench_input() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_serve() -> None:
+    """BENCH_MODE=serve: concurrent serving end-to-end — submitter
+    threads push requests through the ServingServer's admission queue
+    and dynamic micro-batcher (SERVING.md) against a STOP-capable
+    tiny-or-reference model; the headline is the p50 END-TO-END latency
+    a caller observes (enqueue -> resolved future, queue wait and
+    coalescing window included), alongside p99, mean batch fill, and
+    aggregate requests/sec."""
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu.config import HParams
+    from textsummarization_on_flink_tpu.data.vocab import Vocab
+    from textsummarization_on_flink_tpu.decode.decoder import (
+        BeamSearchDecoder,
+    )
+    from textsummarization_on_flink_tpu.models import get_family
+    from textsummarization_on_flink_tpu.serve.batcher import resolve_buckets
+    from textsummarization_on_flink_tpu.serve.server import ServingServer
+
+    reqs = int(os.environ.get("BENCH_SERVE_REQS", "64"))
+    conc = int(os.environ.get("BENCH_SERVE_CONCURRENCY", "8"))
+    batch = int(os.environ.get("BENCH_BATCH", "4"))
+    wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "20"))
+    hps = HParams(batch_size=batch, mode="decode", coverage=True,
+                  serve_max_wait_ms=wait_ms,
+                  serve_max_queue=max(256, reqs), **_preset_overrides())
+    if hps.model_family == "transformer":
+        hps = hps.replace(coverage=False)
+    rng = np.random.RandomState(0)
+    n_words = max(hps.vocab_size - 4, 100)
+    vocab = Vocab(words=[f"w{i}" for i in range(n_words)])
+    pool = [f"w{i}" for i in range(min(n_words, 2000))]
+    # one article per bucket length plus a mixed request stream, so the
+    # warm phase compiles EVERY bucket and the timed phase exercises
+    # bucket routing instead of a single shape
+    buckets = resolve_buckets(hps)
+    articles = []
+    for i in range(32):
+        limit = buckets[i % len(buckets)]
+        n = rng.randint(max(limit // 2, 1), limit + 1)
+        articles.append(" ".join(rng.choice(pool, size=n)))
+    family = get_family(hps.model_family)
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(0))
+    params = _stop_biased(params, hps.vocab_size,
+                          float(os.environ.get("BENCH_STOP_BIAS", "6.0")))
+    tmp = tempfile.mkdtemp(prefix="bench_serve_")
+    try:
+        decoder = BeamSearchDecoder(hps, vocab, batcher=None, params=params,
+                                    decode_root=tmp)
+        server = ServingServer(hps, vocab, decoder=decoder)
+        reg = obs.registry()
+        fill_h = reg.histogram("serve/batch_fill")
+        with server:
+            for b in buckets:  # compile every bucket before timing
+                # exactly b words -> enc_len == b -> bucket_for picks
+                # bucket b itself (a shorter article would warm a
+                # SMALLER bucket and leave b's compile in the timed run)
+                words = [pool[i % len(pool)] for i in range(b)]
+                server.submit(" ".join(words),
+                              uuid=f"warm{b}").result(timeout=1200)
+            fills0 = (fill_h.count, fill_h.sum)
+            lat: list = []
+
+            def one(i: int) -> None:
+                t0 = time.perf_counter()
+                server.submit(articles[i % len(articles)], uuid=f"r{i}",
+                              block=True).result(timeout=1200)
+                lat.append(time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=conc) as ex:
+                list(ex.map(one, range(reqs)))
+            wall = time.perf_counter() - t0
+        n_batches = max(fill_h.count - fills0[0], 1)
+        fill_mean = (fill_h.sum - fills0[1]) / n_batches
+
+        def pct(xs, q):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(len(xs) * q))]
+
+        _, info = _device_info()
+        rec = {
+            "metric": "serve_e2e_p50_latency_ms",
+            "value": round(pct(lat, 0.5) * 1000, 2),
+            "unit": "ms",
+            "vs_baseline": 0.0,  # the reference publishes no serving numbers
+            "p99_ms": round(pct(lat, 0.99) * 1000, 2),
+            "batch_fill_mean": round(fill_mean, 2),
+            "batches": n_batches,
+            "requests_per_sec": round(reqs / wall, 2),
+            "reqs": reqs,
+            "concurrency": conc,
+            "batch": batch,
+            "wait_ms": wait_ms,
+            "buckets": buckets,
+            "shed_total": int(reg.counter("serve/shed_total").value),
+            "degraded_total": int(reg.counter("serve/degraded_total").value),
+            "model_family": hps.model_family,
+            "timing": "wall-clock per request, enqueue -> resolved future "
+                      "(queue wait + coalescing window included)",
+        }
+        rec.update(info)
+        rec.update(_obs_extra())
+        print(json.dumps(rec))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_trainer() -> None:
     """BENCH_MODE=trainer: END-TO-END production-path training
     throughput — the real Trainer.train() over the threaded bucketing
@@ -1208,6 +1340,8 @@ def child_main() -> None:
         bench_input()
     elif mode == "trainer":
         bench_trainer()
+    elif mode == "serve":
+        bench_serve()
     elif mode == "train":
         bench_train()
     else:
@@ -1215,11 +1349,17 @@ def child_main() -> None:
                           "unit": "n/a", "vs_baseline": 0.0,
                           "retryable": False,
                           "error": f"unknown BENCH_MODE={mode!r} (train/"
-                                   f"trainer/decode/attention/flash/input)"}))
+                                   f"trainer/decode/attention/flash/input/"
+                                   f"serve)"}))
         sys.exit(2)
 
 
 if __name__ == "__main__":
+    if "--serve" in sys.argv[1:]:
+        # `python bench.py --serve` == BENCH_MODE=serve; set via env so
+        # the supervisor's fingerprint AND the re-exec'd child (which
+        # never sees argv) both agree on the mode
+        os.environ["BENCH_MODE"] = "serve"
     if os.environ.get("TS_BENCH_CHILD") == "1":
         child_main()
     else:

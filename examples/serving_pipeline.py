@@ -67,6 +67,20 @@ def main():
     for uuid, article, summary, reference in sink.rows:
         print(f"  {uuid}: {article!r} -> {summary!r}")
     assert len(sink.rows) == 4
+
+    # same job, concurrent path (SERVING.md): the ServingServer
+    # micro-batches the stream through the admission-controlled queue;
+    # rows land in completion order, uuid-keyed
+    from textsummarization_on_flink_tpu import obs  # noqa: E402
+
+    sink2 = app.start_inference(model_json,
+                                source=CollectionSource(synthetic_rows(8)),
+                                sink=CollectionSink(), serving=True)
+    assert len(sink2.rows) == 8
+    assert {r[0] for r in sink2.rows} == {f"uuid-{i}" for i in range(8)}
+    fill = obs.registry().histogram("serve/batch_fill")
+    print(f"serving path: {len(sink2.rows)} rows over "
+          f"{fill.count} micro-batch(es), mean fill {fill.mean:.1f}")
     print("OK")
 
 

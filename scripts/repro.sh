@@ -51,12 +51,18 @@ jax.jit(fn).lower(*args)
 print('entry() traces ok')
 g.dryrun_multichip(8)"
 
-echo "== bench smokes (CPU, tiny): train / input / decode"
+echo "== serve smoke (CollectionSource -> ServingServer -> CollectionSink)"
+# the concurrent serving path (SERVING.md) over the 8 synthetic rows:
+# queue admission, micro-batching, bucket padding, future fan-in
+python scripts/serve_smoke.py
+
+echo "== bench smokes (CPU, tiny): train / input / decode / serve"
 T="$(mktemp -d)"
 trap 'rm -rf "$T"' EXIT
-for mode in train input decode; do
+for mode in train input decode serve; do
   BENCH_MODE="$mode" BENCH_PLATFORM=cpu BENCH_PRESET=tiny BENCH_STEPS=2 \
-    BENCH_SECONDS=0.5 BENCH_ATTEMPTS=1 BENCH_STALE_FILE="$T/all.jsonl" \
+    BENCH_SECONDS=0.5 BENCH_SERVE_REQS=8 BENCH_SERVE_CONCURRENCY=4 \
+    BENCH_ATTEMPTS=1 BENCH_STALE_FILE="$T/all.jsonl" \
     python bench.py 2>/dev/null | tail -1
 done
 

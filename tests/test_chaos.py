@@ -532,3 +532,134 @@ class TestDecodeDeadline:
         out = d.decode_batch(batch)  # hps deadline 0 = never degrade
         assert all(not r.degraded for r in out)
         assert reg.counter("resilience/decode_degraded_total").value == 0
+
+
+# -- serve queue under faults (ISSUE 4 chaos satellite) ---------------------
+
+class TestServeChaos:
+    """The serve queue under TS_FAULTS=io.read + injected slow batches:
+    overload sheds and deadline degradations are COUNTED, and nothing
+    hangs — every admitted request resolves within a bound."""
+
+    SERVE_WORDS = ("the a cat dog sat ran mat home big small quick brown "
+                   "fox jumped over lazy it was day night").split()
+
+    def test_flaky_source_plus_slow_batches_shed_and_degrade_never_hang(
+            self, tmp_path, _isolated_obs_and_faults):
+        from textsummarization_on_flink_tpu.serve.errors import (
+            ServeOverloadError,
+        )
+        from textsummarization_on_flink_tpu.serve.server import ServingServer
+
+        reg = _isolated_obs_and_faults
+        vocab = Vocab(words=self.SERVE_WORDS)
+        hps = HParams(mode="decode", batch_size=2, hidden_dim=8, emb_dim=6,
+                      vocab_size=vocab.size(), max_enc_steps=16,
+                      max_dec_steps=6, beam_size=2, min_dec_steps=1,
+                      max_oov_buckets=4, serve_max_wait_ms=5.0,
+                      serve_max_queue=2, decode_deadline_secs=5.0,
+                      serve_buckets="16")
+        state = trainer_lib.init_train_state(hps, vocab.size(), seed=0)
+        inner = dec_lib.BeamSearchDecoder(
+            hps, vocab, batcher=None, params=state.params,
+            decode_root=str(tmp_path / "serve"))
+
+        class SlowDecoder:
+            """Injected slow batches: every dispatch stalls long enough
+            for the 2-deep queue to overflow behind it."""
+
+            def decode_batch(self, batch, deadline=None):
+                time.sleep(0.1)
+                return inner.decode_batch(batch, deadline=deadline)
+
+            def maybe_reload_checkpoint(self, last):
+                return last
+
+        # a flapping peer: the first two read attempts die (same indices
+        # every run), then the stream replays clean — ResilientSource
+        # reconnects with backoff and dedups, exactly like production
+        lines = [io_lib.Message(f"u{i}", "the quick brown fox ran", "",
+                                "r").to_json() for i in range(12)]
+        server_tcp, port = _serve_lines(lines)
+        plan = FaultPlan([FaultSpec("io.read", 1.0, 0, 2)], registry=reg)
+        serve_server = ServingServer(hps, vocab, decoder=SlowDecoder(),
+                                     registry=reg)
+        # pre-warm the compile and force the degradation ladder: with a
+        # huge full-beam estimate every bounded request degrades to
+        # greedy (the decoder's _should_degrade contract)
+        inner._beam_warm = True
+        inner._beam_secs = 100.0
+        admitted, sheds = [], 0
+        try:
+            with faultinject.use_plan(plan), serve_server:
+                src = io_lib.ResilientSource(
+                    lambda: io_lib.SocketSource("127.0.0.1", port,
+                                                max_count=12),
+                    max_reconnects=4, seed=0, sleep=lambda d: None)
+                for row in src.rows():
+                    try:
+                        admitted.append(serve_server.submit(
+                            str(row[1]), uuid=str(row[0])))
+                    except ServeOverloadError:
+                        sheds += 1
+                # NEVER hung: every admitted request resolves in bound
+                results = [f.result(timeout=120) for f in admitted]
+        finally:
+            server_tcp.shutdown()
+            server_tcp.server_close()
+        # the flaky stream reconnected (not silently truncated) ...
+        assert reg.counter("resilience/io_reconnects_total").value == 2
+        assert plan.stats()["io.read"]["fires"] == 2
+        # ... slow batches overflowed the bounded queue into typed sheds
+        assert sheds > 0
+        assert reg.counter("serve/shed_total").value == sheds
+        # ... admitted requests all completed, each degraded to greedy
+        # under the enqueue-measured deadline, and all of it is counted
+        assert len(results) == len(admitted) == 12 - sheds
+        assert all(r.degraded for r in results)
+        assert reg.counter("serve/degraded_total").value == len(results)
+        assert reg.counter(
+            "resilience/decode_degraded_total").value == len(results)
+        assert reg.counter("serve/completed_total").value == len(results)
+
+    def test_injected_dispatch_fault_fails_one_batch_not_the_server(
+            self, _isolated_obs_and_faults):
+        """serve.dispatch injection: the poisoned batch is rejected
+        wholesale with the typed cause; the dispatcher survives and the
+        next batch serves."""
+        from textsummarization_on_flink_tpu.decode.decoder import (
+            DecodedResult,
+        )
+        from textsummarization_on_flink_tpu.serve.server import ServingServer
+
+        reg = _isolated_obs_and_faults
+        vocab = Vocab(words=self.SERVE_WORDS)
+        hps = HParams(mode="decode", batch_size=2, max_enc_steps=8,
+                      max_dec_steps=4, min_dec_steps=1,
+                      serve_max_wait_ms=50.0, serve_max_queue=16,
+                      faults="serve.dispatch:1.0:3:1")
+
+        class EchoDecoder:
+            def decode_batch(self, batch, deadline=None):
+                return [DecodedResult(
+                            uuid=batch.uuids[b],
+                            article=batch.original_articles[b],
+                            decoded_words=["ok"], reference="",
+                            abstract_sents=[])
+                        for b in range(len(batch.uuids))
+                        if batch.real_mask[b]]
+
+            def maybe_reload_checkpoint(self, last):
+                return last
+
+        server = ServingServer(hps, vocab, decoder=EchoDecoder(),
+                               registry=reg)
+        with server:
+            doomed = server.submit("the cat sat", uuid="doomed")
+            with pytest.raises(RuntimeError, match="injected"):
+                doomed.result(timeout=30)
+            ok = server.submit("the dog ran", uuid="ok")
+            assert ok.result(timeout=30).uuid == "ok"
+        assert reg.counter("serve/errors_total").value == 1
+        assert reg.counter("serve/completed_total").value == 1
+        assert reg.counter("resilience/fault/serve.dispatch").value == 1

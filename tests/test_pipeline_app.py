@@ -59,6 +59,34 @@ def test_app_inference_from_model_json(tmp_path):
     assert len(sink.rows) == 2
 
 
+@pytest.mark.slow
+def test_app_inference_serving_path(tmp_path):
+    """serving=True routes start_inference through the concurrent
+    serve/ subsystem (SERVING.md) with the same sources/sinks and the
+    same (uuid, article, summary, reference) rows — no API break."""
+    from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu.obs import Registry
+
+    vocab = Vocab(words=WORDS)
+    app = app_lib.App(train_hps=tiny_hps(tmp_path, "train", num_steps=1),
+                      inference_hps=tiny_hps(tmp_path, "decode",
+                                             serve_max_wait_ms=100.0),
+                      vocab=vocab)
+    model_json = app.start_training(CollectionSource(rows()))
+    with obs.use_registry(Registry()) as reg:
+        sink = app.start_inference(model_json,
+                                   source=CollectionSource(rows(8)),
+                                   sink=CollectionSink(), serving=True)
+        assert {r[0] for r in sink.rows} == {f"uuid-{i}" for i in range(8)}
+        for uuid, article, summary, reference in sink.rows:
+            assert isinstance(summary, str)
+        # the serve layer actually ran (and accounted its rows both in
+        # its own namespace and the pipeline one)
+        assert reg.counter("serve/completed_total").value == 8
+        assert reg.counter("pipeline/rows_out_total").value == 8
+        assert reg.histogram("serve/batch_fill").count >= 1
+
+
 def test_default_hps_match_reference_app():
     t = app_lib.default_train_hps("/tmp/x")
     assert (t.batch_size, t.max_enc_steps, t.max_dec_steps) == (2, 50, 10)

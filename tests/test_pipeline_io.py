@@ -110,6 +110,78 @@ def test_socket_source_round_trip():
     assert [r[0] for r in got] == ["u0", "u1", "u2"]
 
 
+def _one_shot_socket_server(lines):
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            for line in lines:
+                self.wfile.write((line + "\n").encode())
+
+    server = socketserver.TCPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.handle_request, daemon=True).start()
+    return server, server.server_address[1]
+
+
+def test_socket_source_schema_mismatch_raises_typed(monkeypatch):
+    """ISSUE 4 satellite: a payload that PARSES but cannot project onto
+    the declared schema surfaces as the typed SchemaProjectionError
+    (counted in pipeline/feeder_errors_total), never a silent stop."""
+    from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu.obs import Registry
+
+    lines = [io_lib.Message("u0", "art", "", "ref").to_json()]
+    server, port = _one_shot_socket_server(lines)
+    try:
+        with obs.use_registry(Registry()) as reg:
+            # a 2-column schema cannot hold the 4-column Message row
+            src = io_lib.SocketSource(
+                "127.0.0.1", port, max_count=1,
+                schema=io_lib.RowSchema(["uuid", "article"],
+                                        [io_lib.DataTypes.STRING] * 2))
+            with pytest.raises(io_lib.SchemaProjectionError,
+                               match="4 column"):
+                list(src.rows())
+            assert reg.counter("pipeline/feeder_errors_total").value == 1
+    finally:
+        server.server_close()
+
+
+def test_socket_source_non_object_payload_raises_typed():
+    """Valid JSON that is not a message object (a bare list) is a
+    contract violation, not line noise: typed raise, counted."""
+    from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu.obs import Registry
+
+    server, port = _one_shot_socket_server(['[1, 2, 3]'])
+    try:
+        with obs.use_registry(Registry()) as reg:
+            src = io_lib.SocketSource("127.0.0.1", port, max_count=1)
+            with pytest.raises(io_lib.SchemaProjectionError,
+                               match="not a message object"):
+                list(src.rows())
+            assert reg.counter("pipeline/feeder_errors_total").value == 1
+    finally:
+        server.server_close()
+
+
+def test_socket_source_malformed_line_still_dropped_and_counted():
+    """The pre-existing lossy-producer contract survives the satellite:
+    BAD JSON is dropped-and-counted, the stream lives on."""
+    from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu.obs import Registry
+
+    good = io_lib.Message("u0", "art", "", "ref").to_json()
+    server, port = _one_shot_socket_server(["{not json", good])
+    try:
+        with obs.use_registry(Registry()) as reg:
+            src = io_lib.SocketSource("127.0.0.1", port, max_count=1)
+            got = list(src.rows())
+            assert [r[0] for r in got] == ["u0"]
+            assert reg.counter("pipeline/codec_errors_total").value == 1
+            assert reg.counter("pipeline/feeder_errors_total").value == 0
+    finally:
+        server.server_close()
+
+
 def test_socket_sink_writes_json_lines():
     received = []
     ready = threading.Event()

@@ -281,7 +281,8 @@ class TestFaultSpecs:
     def test_known_points_cover_the_documented_set(self):
         assert set(faultinject.KNOWN_POINTS) == {
             "io.connect", "io.read", "io.write",
-            "ckpt.load", "train.step_nan", "etl.worker"}
+            "ckpt.load", "train.step_nan", "etl.worker",
+            "serve.dispatch"}
 
 
 class TestFaultPlan:

@@ -1,0 +1,543 @@
+"""serve/ subsystem: queue admission, micro-batching, buckets, futures,
+and the ServingServer end-to-end contracts (ISSUE 4).
+
+The acceptance test (TestServingIntegration) drives >= 32 concurrent
+requests through a ServingServer over a REAL tiny model and checks:
+(a) measured mean batch fill > 1 (coalescing happened), (b) every
+request resolves exactly once with its own uuid, (c) with
+serve_max_queue forced small, excess requests get ServeOverloadError
+while admitted ones still complete.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.config import HParams, parse_bucket_spec
+from textsummarization_on_flink_tpu.data.batching import SummaryExample
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.decode.decoder import DecodedResult
+from textsummarization_on_flink_tpu.obs import Registry
+from textsummarization_on_flink_tpu.pipeline import io as io_lib
+from textsummarization_on_flink_tpu.resilience.policy import (
+    CircuitBreaker,
+    Deadline,
+)
+from textsummarization_on_flink_tpu.serve import (
+    MicroBatcher,
+    RequestQueue,
+    ServeClosedError,
+    ServeOverloadError,
+    ServeRequest,
+    resolve_buckets,
+)
+from textsummarization_on_flink_tpu.serve.queue import ServeFuture
+from textsummarization_on_flink_tpu.serve.server import ServingServer
+
+WORDS = ("the a cat dog sat ran mat home big small quick brown fox "
+         "jumped over lazy it was day night").split()
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs():
+    with obs.use_registry(Registry()) as reg:
+        yield reg
+
+
+def make_vocab():
+    return Vocab(words=WORDS)
+
+
+def tiny_hps(**kw):
+    base = dict(mode="decode", batch_size=4, hidden_dim=8, emb_dim=6,
+                vocab_size=24, max_enc_steps=16, max_dec_steps=6,
+                beam_size=2, min_dec_steps=1, max_oov_buckets=4,
+                serve_max_wait_ms=50.0, serve_max_queue=64)
+    base.update(kw)
+    return HParams(**base)
+
+
+def make_request(hps, vocab, uuid="u0", article="the cat sat .", **kw):
+    ex = SummaryExample.build(article, [], vocab, hps, uuid=uuid)
+    return ServeRequest(uuid, article, "", ex, **kw)
+
+
+class StubDecoder:
+    """decode_batch-compatible stub: optional per-batch delay, results
+    echo the batch's real rows (one per real_mask=True slot)."""
+
+    def __init__(self, delay: float = 0.0, degrade_under: float = 0.0):
+        self.delay = delay
+        self.degrade_under = degrade_under
+        self.batches = []
+        self.reload_calls = 0
+
+    def decode_batch(self, batch, deadline=None):
+        time.sleep(self.delay)
+        self.batches.append(batch)
+        degraded = bool(
+            self.degrade_under and deadline is not None and deadline.bounded
+            and deadline.remaining() < self.degrade_under)
+        return [DecodedResult(
+                    uuid=batch.uuids[b], article=batch.original_articles[b],
+                    decoded_words=["ok", "."], reference=batch.references[b],
+                    abstract_sents=[], degraded=degraded)
+                for b in range(len(batch.uuids)) if batch.real_mask[b]]
+
+    def maybe_reload_checkpoint(self, last):
+        self.reload_calls += 1
+        return last
+
+
+# -- buckets ---------------------------------------------------------------
+
+class TestBuckets:
+    def test_auto_buckets_reference_scale(self):
+        assert parse_bucket_spec("", 400) == [100, 200, 400]
+
+    def test_auto_buckets_tiny_drops_sub64(self):
+        # tiny configs get ONE bucket — a 4-token bucket saves nothing
+        # and costs a whole extra jit compile
+        assert parse_bucket_spec("", 16) == [16]
+
+    def test_explicit_spec_appends_max(self):
+        assert parse_bucket_spec("8,4", 16) == [4, 8, 16]
+
+    def test_bad_specs_raise(self):
+        with pytest.raises(ValueError, match="not an integer"):
+            parse_bucket_spec("8,x", 16)
+        with pytest.raises(ValueError, match="exceeds max_enc_steps"):
+            parse_bucket_spec("32", 16)
+        with pytest.raises(ValueError, match=">= 1"):
+            parse_bucket_spec("0", 16)
+
+    def test_bucket_for_picks_smallest_cover(self, _isolated_obs):
+        hps = tiny_hps(serve_buckets="4,8,16")
+        q = RequestQueue(8)
+        mb = MicroBatcher(hps, make_vocab(), q)
+        assert mb.bucket_for(1) == 4
+        assert mb.bucket_for(4) == 4
+        assert mb.bucket_for(5) == 8
+        assert mb.bucket_for(16) == 16
+
+    def test_resolve_buckets_from_hps(self):
+        assert resolve_buckets(tiny_hps(serve_buckets="8")) == [8, 16]
+
+
+# -- futures ---------------------------------------------------------------
+
+class TestServeFuture:
+    def test_result_blocks_then_returns(self):
+        fut = ServeFuture("u1")
+        threading.Timer(0.05, lambda: fut._resolve("ok")).start()
+        assert fut.result(timeout=5.0) == "ok"
+        assert fut.done()
+
+    def test_reject_reraises(self):
+        fut = ServeFuture("u1")
+        fut._reject(ValueError("boom"))
+        with pytest.raises(ValueError, match="boom"):
+            fut.result(timeout=0.1)
+        assert fut.error is not None
+
+    def test_resolves_exactly_once(self):
+        fut = ServeFuture("u1")
+        fut._resolve("ok")
+        with pytest.raises(AssertionError, match="twice"):
+            fut._resolve("again")
+        with pytest.raises(AssertionError, match="twice"):
+            fut._reject(ValueError("late"))
+
+    def test_timeout_raises(self):
+        with pytest.raises(TimeoutError):
+            ServeFuture("u1").result(timeout=0.01)
+
+    def test_callback_after_done_runs_immediately(self):
+        fut = ServeFuture("u1")
+        seen = []
+        fut.add_done_callback(lambda f: seen.append(("pre", f.error)))
+        fut._resolve("ok")
+        fut.add_done_callback(lambda f: seen.append(("post", f.error)))
+        assert seen == [("pre", None), ("post", None)]
+
+    def test_callback_error_counted_not_fatal(self, _isolated_obs):
+        fut = ServeFuture("u1", registry=_isolated_obs)
+
+        def bad(_f):
+            raise RuntimeError("sink died")
+
+        fut.add_done_callback(bad)
+        fut._resolve("ok")  # must not raise
+        assert _isolated_obs.counter(
+            "serve/callback_errors_total").value == 1
+
+
+# -- queue / admission -----------------------------------------------------
+
+class TestRequestQueue:
+    def test_full_queue_rejects_typed(self, _isolated_obs):
+        hps, vocab = tiny_hps(), make_vocab()
+        q = RequestQueue(2, registry=_isolated_obs)
+        q.submit(make_request(hps, vocab, "a"))
+        q.submit(make_request(hps, vocab, "b"))
+        with pytest.raises(ServeOverloadError, match="queue full"):
+            q.submit(make_request(hps, vocab, "c"))
+        assert _isolated_obs.counter("serve/shed_total").value == 1
+        assert _isolated_obs.counter("serve/submitted_total").value == 2
+
+    def test_breaker_opens_under_sustained_overload(self, _isolated_obs):
+        hps, vocab = tiny_hps(), make_vocab()
+        clock = [0.0]
+        breaker = CircuitBreaker(threshold=3, reset_secs=30.0,
+                                 name="serve.admission",
+                                 clock=lambda: clock[0],
+                                 registry=_isolated_obs)
+        q = RequestQueue(1, breaker=breaker, registry=_isolated_obs)
+        q.submit(make_request(hps, vocab, "a"))
+        for i in range(3):  # 3 consecutive rejects trip the breaker
+            with pytest.raises(ServeOverloadError):
+                q.submit(make_request(hps, vocab, f"r{i}"))
+        assert breaker.state == CircuitBreaker.OPEN
+        # open breaker sheds BEFORE touching the queue — even though
+        # space exists now
+        assert q.get(timeout=0.1) is not None
+        with pytest.raises(ServeOverloadError, match="breaker open"):
+            q.submit(make_request(hps, vocab, "x"))
+        # reset window elapses: the half-open probe admission heals it
+        clock[0] = 31.0
+        q.submit(make_request(hps, vocab, "y"))
+        assert breaker.state == CircuitBreaker.CLOSED
+
+    def test_blocking_submit_backpressures(self, _isolated_obs):
+        hps, vocab = tiny_hps(), make_vocab()
+        q = RequestQueue(1, registry=_isolated_obs)
+        q.submit(make_request(hps, vocab, "a"))
+        threading.Timer(0.05, q.get).start()
+        t0 = time.monotonic()
+        q.submit(make_request(hps, vocab, "b"), block=True, timeout=5.0)
+        assert time.monotonic() - t0 < 5.0  # waited for space, not full 5s
+        with pytest.raises(ServeOverloadError):
+            q.submit(make_request(hps, vocab, "c"), block=True, timeout=0.05)
+
+    def test_closed_queue_refuses(self, _isolated_obs):
+        hps, vocab = tiny_hps(), make_vocab()
+        q = RequestQueue(4, registry=_isolated_obs)
+        q.close()
+        with pytest.raises(ServeClosedError):
+            q.submit(make_request(hps, vocab, "a"))
+
+    def test_drain_reject_resolves_pending(self, _isolated_obs):
+        hps, vocab = tiny_hps(), make_vocab()
+        q = RequestQueue(4, registry=_isolated_obs)
+        reqs = [make_request(hps, vocab, f"u{i}") for i in range(3)]
+        for r in reqs:
+            q.submit(r)
+        assert q.drain_reject(ServeClosedError("stopping")) == 3
+        for r in reqs:
+            with pytest.raises(ServeClosedError):
+                r.future.result(timeout=0.1)
+
+
+# -- micro-batcher ---------------------------------------------------------
+
+class TestMicroBatcher:
+    def test_coalesces_up_to_max_batch(self, _isolated_obs):
+        hps, vocab = tiny_hps(serve_max_wait_ms=200.0), make_vocab()
+        q = RequestQueue(16, registry=_isolated_obs)
+        for i in range(6):
+            q.submit(make_request(hps, vocab, f"u{i}"))
+        mb = MicroBatcher(hps, vocab, q, registry=_isolated_obs)
+        g1 = mb.next_group()
+        g2 = mb.next_group()
+        assert [r.uuid for r in g1] == ["u0", "u1", "u2", "u3"]
+        assert [r.uuid for r in g2] == ["u4", "u5"]
+        assert mb.next_group(poll=0.01) is None  # idle
+
+    def test_serve_max_batch_caps_below_batch_size(self, _isolated_obs):
+        hps, vocab = tiny_hps(serve_max_batch=2), make_vocab()
+        q = RequestQueue(16, registry=_isolated_obs)
+        for i in range(4):
+            q.submit(make_request(hps, vocab, f"u{i}"))
+        mb = MicroBatcher(hps, vocab, q, registry=_isolated_obs)
+        assert len(mb.next_group()) == 2
+
+    def test_window_ships_partial_batch(self, _isolated_obs):
+        hps, vocab = tiny_hps(serve_max_wait_ms=30.0), make_vocab()
+        q = RequestQueue(16, registry=_isolated_obs)
+        q.submit(make_request(hps, vocab, "only"))
+        mb = MicroBatcher(hps, vocab, q, registry=_isolated_obs)
+        t0 = time.monotonic()
+        group = mb.next_group()
+        dt = time.monotonic() - t0
+        assert [r.uuid for r in group] == ["only"]
+        assert dt < 5.0  # waited ~the window, not forever
+
+    def test_build_pads_batch_and_bucket(self, _isolated_obs):
+        hps, vocab = tiny_hps(serve_buckets="4,8,16"), make_vocab()
+        q = RequestQueue(16, registry=_isolated_obs)
+        mb = MicroBatcher(hps, vocab, q, registry=_isolated_obs)
+        reqs = [make_request(hps, vocab, "a", article="the cat sat ."),
+                make_request(hps, vocab, "b",
+                             article="the quick brown fox ran over it")]
+        batch = mb.build(reqs)
+        # batch axis padded to batch_size, encoder axis to the 8-bucket
+        # (longest article = 7 tokens)
+        assert batch.enc_batch.shape == (4, 8)
+        assert batch.real_mask == [True, True, False, False]
+        assert batch.uuids[:2] == ["a", "b"]
+        assert _isolated_obs.counter("serve/pad_rows_total").value == 2
+        fill = _isolated_obs.histogram("serve/batch_fill")
+        assert fill.count == 1 and fill.mean == 2.0
+
+
+# -- server (stub decoder: queue/dispatch semantics, no jax) ---------------
+
+class TestServingServerStub:
+    def test_requests_resolve_with_own_uuid(self, _isolated_obs):
+        hps, vocab = tiny_hps(), make_vocab()
+        server = ServingServer(hps, vocab, decoder=StubDecoder(0.01),
+                               registry=_isolated_obs)
+        with server:
+            futs = [server.submit("the cat sat .", uuid=f"u{i}")
+                    for i in range(10)]
+            results = [f.result(timeout=30) for f in futs]
+        assert [r.uuid for r in results] == [f"u{i}" for i in range(10)]
+        assert _isolated_obs.counter("serve/completed_total").value == 10
+
+    def test_submit_after_stop_raises_closed(self, _isolated_obs):
+        hps, vocab = tiny_hps(), make_vocab()
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               registry=_isolated_obs)
+        server.start()
+        server.stop()
+        with pytest.raises(ServeClosedError):
+            server.submit("the cat .")
+
+    def test_stop_drains_admitted_requests(self, _isolated_obs):
+        hps, vocab = tiny_hps(serve_max_wait_ms=5.0), make_vocab()
+        server = ServingServer(hps, vocab, decoder=StubDecoder(0.02),
+                               registry=_isolated_obs)
+        server.start()
+        futs = [server.submit("the cat .", uuid=f"u{i}") for i in range(8)]
+        server.stop()  # drain-then-join: every admitted request resolves
+        assert all(f.done() for f in futs)
+        assert [f.result(0.1).uuid for f in futs] == \
+            [f"u{i}" for i in range(8)]
+
+    def test_dispatch_failure_rejects_batch_only(self, _isolated_obs):
+        hps, vocab = tiny_hps(serve_max_wait_ms=100.0,
+                              faults="serve.dispatch:1.0:0:1"), make_vocab()
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               registry=_isolated_obs)
+        with server:
+            # batch 1 eats the injected fault and is rejected wholesale
+            bad = [server.submit("the cat .", uuid=f"bad{i}")
+                   for i in range(2)]
+            for f in bad:
+                with pytest.raises(RuntimeError, match="injected"):
+                    f.result(timeout=30)
+            # the server survives: batch 2 serves normally
+            ok = server.submit("the dog ran .", uuid="ok")
+            assert ok.result(timeout=30).uuid == "ok"
+        assert _isolated_obs.counter("serve/errors_total").value == 2
+        assert _isolated_obs.counter("serve/completed_total").value == 1
+
+    def test_tightest_deadline_drives_degradation_tag(self, _isolated_obs):
+        # stub degrades when the batch deadline budget is under 10s:
+        # the per-request deadline (from enqueue) reaches the decoder
+        hps, vocab = tiny_hps(decode_deadline_secs=5.0), make_vocab()
+        server = ServingServer(hps, vocab,
+                               decoder=StubDecoder(degrade_under=10.0),
+                               registry=_isolated_obs)
+        with server:
+            res = server.submit("the cat .", uuid="d0").result(timeout=30)
+        assert res.degraded
+        assert _isolated_obs.counter("serve/degraded_total").value == 1
+
+    def test_serve_drives_source_to_sink(self, _isolated_obs):
+        hps, vocab = tiny_hps(), make_vocab()
+        rows = [(f"uuid-{i}", f"the cat sat {i} .", "", f"ref {i}")
+                for i in range(8)]
+        server = ServingServer(hps, vocab, decoder=StubDecoder(0.01),
+                               registry=_isolated_obs)
+        sink = io_lib.CollectionSink()
+        with server:
+            out = server.serve(io_lib.CollectionSource(rows), sink)
+        assert out is sink
+        assert {r[0] for r in sink.rows} == {f"uuid-{i}" for i in range(8)}
+        # (uuid, article, summary, reference) row shape, per-record flush
+        uuid, article, summary, reference = sink.rows[0]
+        assert summary == "ok ."
+        assert _isolated_obs.counter("serve/sink_rows_total").value == 8
+
+    def test_reload_failure_does_not_kill_dispatcher(self, _isolated_obs):
+        """A failed between-batch checkpoint reload is counted and the
+        server keeps serving on its current params — it must never
+        unwind the dispatch thread (which would hang every queued and
+        future request)."""
+        class ReloadBomb(StubDecoder):
+            def maybe_reload_checkpoint(self, last):
+                raise FileNotFoundError("checkpoint dir vanished")
+
+        hps, vocab = tiny_hps(serve_max_wait_ms=5.0), make_vocab()
+        server = ServingServer(hps, vocab, decoder=ReloadBomb(),
+                               registry=_isolated_obs)
+        with server:
+            first = server.submit("the cat .", uuid="a").result(timeout=30)
+            # the reload after batch 1 raised; batch 2 must still serve
+            second = server.submit("the dog .", uuid="b").result(timeout=30)
+        assert (first.uuid, second.uuid) == ("a", "b")
+        assert _isolated_obs.counter(
+            "serve/ckpt_reload_errors_total").value >= 1
+        assert _isolated_obs.counter("serve/errors_total").value == 0
+
+    def test_serve_max_count_bounds_unbounded_source(self, _isolated_obs):
+        """serve(max_count=N) stops pulling after N rows — the bound
+        transform(serving=True, max_batches=...) maps onto."""
+        hps, vocab = tiny_hps(), make_vocab()
+
+        def endless():
+            i = 0
+            while True:
+                yield (f"uuid-{i}", "the cat .", "", "r")
+                i += 1
+
+        src = io_lib.IteratorSource(endless)
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               registry=_isolated_obs)
+        sink = io_lib.CollectionSink()
+        with server:
+            server.serve(src, sink, max_count=6)
+        assert len(sink.rows) == 6
+
+    def test_serve_dispatch_error_counts_once_per_request(
+            self, _isolated_obs):
+        """serve/errors_total is counted at the rejection site only:
+        the serve() drain loop must not double-count failed futures."""
+        hps, vocab = tiny_hps(serve_max_wait_ms=100.0,
+                              faults="serve.dispatch:1.0:0"), make_vocab()
+        rows = [(f"uuid-{i}", "the cat .", "", "r") for i in range(2)]
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               registry=_isolated_obs)
+        with server:
+            with pytest.raises(RuntimeError, match="injected"):
+                server.serve(io_lib.CollectionSource(rows),
+                             io_lib.CollectionSink())
+        assert _isolated_obs.counter("serve/errors_total").value == 2
+
+    def test_serve_rejects_schema_mismatch_typed(self, _isolated_obs):
+        hps, vocab = tiny_hps(), make_vocab()
+        src = io_lib.CollectionSource(
+            [("only-two", "cols")],
+            schema=io_lib.RowSchema(["uuid", "article"],
+                                    [io_lib.DataTypes.STRING] * 2))
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               registry=_isolated_obs)
+        with server:
+            with pytest.raises(io_lib.SchemaProjectionError):
+                server.serve(src, io_lib.CollectionSink())
+        assert _isolated_obs.counter(
+            "pipeline/feeder_errors_total").value == 1
+
+
+# -- acceptance: >= 32 concurrent requests against a real tiny model -------
+
+class TestServingIntegration:
+    @pytest.fixture(scope="class")
+    def model_setup(self):
+        from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+
+        vocab = make_vocab()
+        hps = tiny_hps(vocab_size=vocab.size(), serve_max_wait_ms=150.0,
+                       serve_buckets="16")
+        params = trainer_lib.init_train_state(hps, vocab.size(),
+                                              seed=0).params
+        return hps, vocab, params
+
+    def test_32_concurrent_requests_coalesce_and_resolve_once(
+            self, model_setup, tmp_path, _isolated_obs):
+        """Acceptance (a)+(b): 32 concurrent submitters share device
+        dispatches (mean fill > 1) and each future resolves exactly
+        once with its own uuid."""
+        hps, vocab, params = model_setup
+        reg = _isolated_obs
+        server = ServingServer(hps, vocab, params=params,
+                               decode_root=str(tmp_path / "serve"),
+                               registry=reg)
+        resolved = []
+        resolved_lock = threading.Lock()
+
+        def count_resolution(fut):
+            with resolved_lock:
+                resolved.append(fut.uuid)
+
+        with server:
+            # warm the jit cache so the compile doesn't eat the window
+            server.submit("the cat sat .", uuid="warm").result(timeout=300)
+            fills_before = reg.histogram("serve/batch_fill").count
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                futs = list(ex.map(
+                    lambda i: server.submit(
+                        "the quick brown fox jumped over the lazy dog .",
+                        uuid=f"u{i}"), range(32)))
+            for f in futs:
+                f.add_done_callback(count_resolution)
+            results = [f.result(timeout=300) for f in futs]
+        # (b) exactly once, own uuid: in-order zip, one callback each
+        assert [r.uuid for r in results] == [f"u{i}" for i in range(32)]
+        assert sorted(resolved) == sorted(f"u{i}" for i in range(32))
+        for r in results:
+            assert isinstance(r.summary, str)
+        # (a) coalescing happened: 32 requests over < 32 dispatches
+        fill = reg.histogram("serve/batch_fill")
+        n_batches = fill.count - fills_before
+        assert n_batches < 32
+        mean_fill = (fill.sum - 1) / n_batches  # minus the fill-1 warm
+        assert mean_fill > 1.0
+        assert reg.counter("serve/completed_total").value == 33
+
+    def test_small_queue_sheds_excess_but_serves_admitted(
+            self, model_setup, tmp_path, _isolated_obs):
+        """Acceptance (c): serve_max_queue forced small + slow batches
+        -> excess requests get the typed ServeOverloadError while every
+        admitted one still completes."""
+        hps, vocab, params = model_setup
+        hps = hps.replace(serve_max_queue=2, serve_max_wait_ms=5.0)
+        reg = _isolated_obs
+        from textsummarization_on_flink_tpu.decode.decoder import (
+            BeamSearchDecoder,
+        )
+
+        inner = BeamSearchDecoder(hps, vocab, batcher=None, params=params,
+                                  decode_root=str(tmp_path / "serve2"))
+
+        class SlowDecoder:
+            def decode_batch(self, batch, deadline=None):
+                time.sleep(0.15)  # hold the dispatcher so the queue fills
+                return inner.decode_batch(batch, deadline=deadline)
+
+            def maybe_reload_checkpoint(self, last):
+                return last
+
+        server = ServingServer(hps, vocab, decoder=SlowDecoder(),
+                               registry=reg)
+        admitted, sheds = [], 0
+        with server:
+            server.submit("the cat sat .", uuid="warm").result(timeout=300)
+            for i in range(32):
+                try:
+                    admitted.append(server.submit(
+                        "a big dog ran home .", uuid=f"u{i}"))
+                except ServeOverloadError:
+                    sheds += 1
+            results = [f.result(timeout=300) for f in admitted]
+        assert sheds > 0
+        assert len(admitted) >= 1
+        # every ADMITTED request completed, with its own uuid
+        assert [r.uuid for r in results] == [f.uuid for f in admitted]
+        assert reg.counter("serve/shed_total").value == sheds
+        assert reg.counter("serve/completed_total").value == \
+            len(admitted) + 1

@@ -148,6 +148,30 @@ class HParams:
     # results degraded=True (counted in resilience/decode_degraded_total).
     # 0 (default) = no deadline, never degrade.
     decode_deadline_secs: float = 0.0
+    # ---- concurrent serving (SERVING.md; ISSUE 4) ----
+    # Requests coalesced per device dispatch by the serve/ micro-batcher
+    # (0 = use batch_size; must be <= batch_size — the device batch
+    # shape is always batch_size, short micro-batches are padded with
+    # real_mask=False repeats).
+    serve_max_batch: int = 0
+    # Micro-batch coalescing window in milliseconds: after the first
+    # request of a batch arrives, the batcher waits at most this long
+    # for neighbors before dispatching a partial batch.  0 = dispatch
+    # immediately (latency-first, fill suffers).
+    serve_max_wait_ms: float = 20.0
+    # Admission-controlled request queue depth: a non-blocking submit
+    # against a full queue is rejected with the typed ServeOverloadError
+    # (and counts against the admission circuit breaker — sustained
+    # overload sheds immediately, BreakerSink semantics).
+    serve_max_queue: int = 256
+    # Encoder-length padding buckets for serving, as a comma-separated
+    # ascending list of lengths (e.g. "100,200,400"); each micro-batch
+    # pads to the smallest bucket covering its longest article, so the
+    # beam-search jit cache stays bounded at len(buckets) entries per
+    # beam width (hits/misses visible in decode/compile_cache_*_total).
+    # "" = auto: {max_enc_steps//4, //2, max_enc_steps}, dropping
+    # sub-64 buckets (except max_enc_steps itself).
+    serve_buckets: str = ""
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score
@@ -296,12 +320,69 @@ class HParams:
         if self.decode_deadline_secs < 0:
             raise ValueError(f"decode_deadline_secs must be >= 0, got "
                              f"{self.decode_deadline_secs}")
+        if self.serve_max_batch < 0 or self.serve_max_batch > self.batch_size:
+            raise ValueError(
+                f"serve_max_batch must be in [0, batch_size={self.batch_size}]"
+                f", got {self.serve_max_batch}")
+        if self.serve_max_wait_ms < 0:
+            raise ValueError(f"serve_max_wait_ms must be >= 0, got "
+                             f"{self.serve_max_wait_ms}")
+        if self.serve_max_queue < 1:
+            raise ValueError(f"serve_max_queue must be >= 1, got "
+                             f"{self.serve_max_queue}")
+        # parse for validation only — bad bucket specs fail at config
+        # time, not at the first micro-batch
+        parse_bucket_spec(self.serve_buckets, self.max_enc_steps)
         if self.faults:
             # parse for validation only (unknown points / bad probs fail
             # here, at config time, not at the injection site)
             from textsummarization_on_flink_tpu.resilience import faultinject
 
             faultinject.parse(self.faults)
+
+
+def parse_bucket_spec(spec: str, max_enc_steps: int) -> "List[int]":
+    """Resolve ``serve_buckets`` to the ascending encoder-length bucket
+    list the serve/ micro-batcher pads into (SERVING.md).
+
+    The ONE parser: HParams.validate() and serve/batcher.py both resolve
+    through this, so a spec that validates is exactly the spec that
+    serves.  ``max_enc_steps`` is always the top bucket — an article is
+    already truncated to it by SummaryExample.build, so every request
+    fits some bucket.  Auto ("" spec): {max//4, max//2, max}, dropping
+    sub-64 buckets (a tiny bucket saves little padding but costs a
+    whole extra jit-cache entry); explicit specs keep every entry.
+    Dependency-light (no jax/numpy) so config stays importable anywhere.
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        buckets = sorted({max_enc_steps // 4, max_enc_steps // 2,
+                          max_enc_steps})
+        return [b for b in buckets
+                if b == max_enc_steps or b >= 64]
+    out = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        try:
+            b = int(tok)
+        except ValueError:
+            raise ValueError(
+                f"serve_buckets entry {tok!r} is not an integer") from None
+        if b < 1:
+            raise ValueError(f"serve_buckets entries must be >= 1, got {b}")
+        if b > max_enc_steps:
+            raise ValueError(
+                f"serve_buckets entry {b} exceeds max_enc_steps="
+                f"{max_enc_steps} (padding past the model's static "
+                f"encoder budget buys nothing)")
+        out.append(b)
+    buckets = sorted(set(out))
+    if not buckets or buckets[-1] != max_enc_steps:
+        # the top bucket must cover every admissible article
+        buckets.append(max_enc_steps)
+    return buckets
 
 
 def beam_chunk_from_env() -> int:

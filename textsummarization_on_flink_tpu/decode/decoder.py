@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -131,6 +132,11 @@ class BeamSearchDecoder:
         self._batcher = batcher
         self._train_dir = train_dir
         self._max_ckpt_retries = max_ckpt_retries
+        # guards the (params, ckpt_path) PAIR: continuous-mode reloads
+        # (and the serve/ hot-swap) replace both together, and a
+        # concurrent decode_batch must never observe a half-swapped
+        # state (new params with the old checkpoint name, or vice versa)
+        self._params_lock = threading.Lock()
         self._ckpt_path: Optional[str] = None
         # observability (`decode/` namespace, OBSERVABILITY.md):
         # per-request latency percentiles, finished beams, token volume
@@ -191,12 +197,21 @@ class BeamSearchDecoder:
             os.makedirs(self._rouge_dec_dir, exist_ok=True)
 
     # -- checkpoint handling --
+    def _params_snapshot(self) -> Tuple[Any, Optional[str]]:
+        """Atomic read of the (params, ckpt_path) pair — the one sanctioned
+        way for a dispatch to pick up weights while reloads may run."""
+        with self._params_lock:
+            return self._params, self._ckpt_path
+
     def _load_params(self) -> None:
+        # load + decode OUTSIDE the lock (seconds of IO must not stall
+        # concurrent dispatches); only the pointer swap is locked
         path, flat = ckpt_lib.load_ckpt(self._train_dir,
                                         max_retries=self._max_ckpt_retries)
         state = ckpt_lib.arrays_to_state(flat)
-        self._params = state.params
-        self._ckpt_path = path
+        with self._params_lock:
+            self._params = state.params
+            self._ckpt_path = path
         log.info("decoder loaded checkpoint %s", path)
 
     def maybe_reload_checkpoint(self, last_load: float) -> float:
@@ -204,13 +219,22 @@ class BeamSearchDecoder:
 
         ``last_load`` is a ``time.monotonic()`` reference: the 60s reload
         cadence is a duration, and a wall-clock jump (NTP slew, suspend)
-        must neither storm reloads nor starve them (TS003)."""
+        must neither storm reloads nor starve them (TS003).
+
+        Thread-safe hot-swap (ISSUE 4 satellite): the (params,
+        ckpt_path) pair swaps under ``_params_lock``, so a concurrent
+        ``decode_batch`` (the serve/ dispatch thread, or any
+        out-of-band caller) sees either the old pair or the new one —
+        never a half-swap.  Each swap bumps
+        ``decode/ckpt_reloads_total``.  The sharded (mesh) search closes
+        over its initial params and does NOT hot-swap."""
         if self._train_dir is None:
             return last_load
         if time.monotonic() - last_load < SECS_UNTIL_NEW_CKPT:
             return last_load
         latest = ckpt_lib.latest_checkpoint(self._train_dir)
-        if latest is not None and latest != self._ckpt_path:
+        _, current = self._params_snapshot()
+        if latest is not None and latest != current:
             log.info("Decoder has been decoding for %.0f seconds; loading "
                      "new checkpoint", time.monotonic() - last_load)
             self._load_params()
@@ -285,19 +309,22 @@ class BeamSearchDecoder:
 
     def _decode_batch_inner(self, batch: Batch,
                             degraded: bool = False) -> List[DecodedResult]:
+        # one atomic params read per dispatch: a checkpoint hot-swap
+        # landing mid-batch affects the NEXT dispatch, never this one
+        params, _ = self._params_snapshot()
         if self._sharded_search is not None:
             from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
 
             enc_only = {k: v for k, v in batch.as_arrays().items()
                         if k.startswith("enc_")}
             raw = self._sharded_search(
-                self._params, mesh_lib.shard_batch(self._mesh_plan, enc_only))
+                params, mesh_lib.shard_batch(self._mesh_plan, enc_only))
             out = beam_search.BeamSearchOutput(
                 *[np.asarray(x) for x in raw])
         else:
             hps = (self._hps.replace(beam_size=1) if degraded
                    else self._hps)
-            out = beam_search.run_beam_search(self._params, hps,
+            out = beam_search.run_beam_search(params, hps,
                                               batch.as_arrays())
         results: List[DecodedResult] = []
         for b in range(len(batch.original_articles)):

@@ -127,8 +127,13 @@ class App:
     def start_inference(self, model_json: Optional[str] = None,
                         source: Optional[Source] = None,
                         sink: Optional[Sink] = None,
-                        max_count: int = 0) -> Sink:
-        """Serve summaries from the stream (App.startInference, :108-132)."""
+                        max_count: int = 0, serving: bool = False) -> Sink:
+        """Serve summaries from the stream (App.startInference, :108-132).
+
+        serving=True routes through the concurrent ``serve/`` subsystem
+        (dynamic micro-batching + admission control, SERVING.md) instead
+        of the synchronous decode loop — same sources/sinks, same output
+        rows, no API break for existing callers."""
         src = source or KafkaSource(INPUT_TOPIC, self.bootstrap_servers,
                                     max_count=max_count)
         out = sink or KafkaSink(OUTPUT_TOPIC, self.bootstrap_servers)
@@ -140,7 +145,7 @@ class App:
             model = self.create_model()
         reg = obs.registry_for(self.inference_hps)
         with obs.spans.span(reg, "pipeline/inference_job"):
-            result = model.transform(src, out)
+            result = model.transform(src, out, serving=serving)
         reg.counter("pipeline/inference_jobs_total").inc()
         return result
 

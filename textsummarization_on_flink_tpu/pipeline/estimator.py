@@ -257,7 +257,17 @@ class SummarizationModel(Model,
         return ARTICLE_OUTPUT_SCHEMA  # (uuid, article, summary, reference)
 
     def transform(self, source: Source, sink: Optional[Sink] = None,
-                  max_batches: int = 0) -> Sink:
+                  max_batches: int = 0, serving: bool = False) -> Sink:
+        """serving=False (default): the original synchronous path —
+        bridge feeder -> threaded Batcher -> decoder.decode() loop.
+        serving=True: route the same rows through the concurrent
+        ``serve.ServingServer`` (admission-controlled queue + dynamic
+        micro-batching + shape buckets, SERVING.md) — same
+        (uuid, article, summary, reference) rows out, but sink order
+        follows completion, not arrival (rows are uuid-keyed)."""
+        if serving:
+            return self._transform_serving(source, sink,
+                                           max_batches=max_batches)
         hps = self._hps()
         hps.validate()
         vocab = self._vocab(hps)
@@ -294,6 +304,45 @@ class SummarizationModel(Model,
                                log_results=False)
         finally:
             feeder.finish()
+        return out_sink
+
+    def _transform_serving(self, source: Source,
+                           sink: Optional[Sink] = None,
+                           max_batches: int = 0) -> Sink:
+        """Concurrent transform: ServingServer drives the source/sink
+        pair through the admission-controlled queue (SERVING.md).
+
+        ``max_batches`` keeps its synchronous-path meaning of bounding
+        work against an unbounded source: serving batches are dynamic,
+        so the bound maps to at most ``max_batches * batch_size`` rows
+        (== max_batches FULL device batches' worth)."""
+        from textsummarization_on_flink_tpu.serve.server import ServingServer
+
+        hps = self._hps()
+        hps.validate()
+        vocab = self._vocab(hps)
+        out_sink = sink if sink is not None else CollectionSink()
+        reg = obs.registry_for(hps)
+        c_out = reg.counter("pipeline/rows_out_total")
+
+        class _CountedSink(Sink):
+            # keep the pipeline-layer row accounting identical to the
+            # synchronous path while returning the caller's own sink
+            def write(self, row: Row) -> None:
+                out_sink.write(row)
+                c_out.inc()
+
+        server = ServingServer(
+            hps.replace(single_pass=False), vocab,
+            train_dir=train_dir_for(hps),
+            decode_root=os.path.join(hps.log_root or ".",
+                                     hps.exp_name or "exp"),
+            registry=reg)
+        with obs.spans.span(reg, "pipeline/transform_serving"):
+            with server:
+                server.serve(source, _CountedSink(),
+                             cols=self.get_inference_selected_cols(),
+                             max_count=max_batches * hps.batch_size)
         return out_sink
 
 

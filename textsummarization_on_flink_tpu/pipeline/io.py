@@ -66,6 +66,20 @@ except ImportError:
 Row = Tuple[Any, ...]
 
 
+class SchemaProjectionError(ValueError):
+    """A wire row cannot be projected onto the consumer's schema (wrong
+    arity, non-object payload, missing columns).
+
+    Distinct from a *malformed* line (bad JSON — dropped and counted in
+    ``pipeline/codec_errors_total``: a lossy producer must not kill a
+    long-lived stream): a row that PARSES but cannot match the declared
+    schema means the producer and consumer disagree about the contract,
+    and silently stopping (or dropping) would truncate the dataset with
+    no signal.  Typed so stream supervisors can route on it; every
+    raise is counted in ``pipeline/feeder_errors_total``."""
+
+
+
 def _count_source_row() -> None:
     obs.counter("pipeline/source_rows_total").inc()
 
@@ -214,6 +228,13 @@ class SocketSource(Source):
     ``idle_timeout`` seconds without delivering a byte raises the typed
     ``StreamIdleError``.  Wrap in ``ResilientSource`` for
     reconnect-with-backoff on top.
+
+    Schema contract (ISSUE 4 satellite): a payload that parses but
+    cannot project onto the declared schema raises the typed
+    ``SchemaProjectionError`` (counted in
+    ``pipeline/feeder_errors_total``) instead of silently ending the
+    stream; malformed JSON lines are still dropped-and-counted
+    (``pipeline/codec_errors_total``) as before.
     """
 
     def __init__(self, host: str, port: int, max_count: int = 0,
@@ -262,6 +283,21 @@ class SocketSource(Source):
                     obs.counter("pipeline/codec_errors_total").inc()
                     log.warning("dropping malformed socket line: %.80r", line)
                     continue
+                except AttributeError as e:
+                    # valid JSON but not an object-shaped message (a
+                    # bare list/number): the row can never project onto
+                    # the schema — a contract violation, not line noise.
+                    # Surface it typed instead of the old silent stop.
+                    obs.counter("pipeline/feeder_errors_total").inc()
+                    raise SchemaProjectionError(
+                        f"socket payload is not a message object and "
+                        f"cannot project onto {self.schema!r}: "
+                        f"{line[:80]!r}") from e
+                if len(row) != len(self.schema):
+                    obs.counter("pipeline/feeder_errors_total").inc()
+                    raise SchemaProjectionError(
+                        f"socket row has {len(row)} column(s) but the "
+                        f"declared schema is {self.schema!r}")
                 _count_source_row()
                 yield row
                 n += 1

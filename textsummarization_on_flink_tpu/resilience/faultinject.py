@@ -55,6 +55,7 @@ ENV_VAR = "TS_FAULTS"
 KNOWN_POINTS = (
     "io.connect", "io.read", "io.write",
     "ckpt.load", "train.step_nan", "etl.worker",
+    "serve.dispatch",
 )
 
 

@@ -1,0 +1,60 @@
+"""Concurrent request serving: dynamic micro-batching, shape buckets,
+admission control (ISSUE 4 tentpole; SERVING.md).
+
+The decode path dispatches one compiled program per batch; this package
+turns that into a *service*: a thread-safe admission-controlled request
+queue (``serve.queue``), a time/size micro-batcher that coalesces
+independent requests into bucket-padded device batches
+(``serve.batcher``), and a ``ServingServer`` (``serve.server``) whose
+single dispatch thread runs them through ``BeamSearchDecoder`` — each
+request resolving its own ``ServeFuture`` exactly once.
+
+Layer map:
+  * ``errors``  — ``ServeOverloadError`` / ``ServeClosedError`` (typed,
+    under the resilience taxonomy).
+  * ``queue``   — ``ServeFuture`` / ``ServeRequest`` / ``RequestQueue``
+    (bounded depth + admission circuit breaker; jax-free).
+  * ``batcher`` — ``MicroBatcher`` + ``resolve_buckets`` (coalescing
+    window ``serve_max_wait_ms``, size cap ``serve_max_batch``,
+    encoder-length buckets ``serve_buckets``; jax-free).
+  * ``server``  — ``ServingServer``: submit()/serve() fronting the
+    decoder, deadline-from-enqueue degradation, between-batch
+    checkpoint hot-swap, full obs instrumentation.
+
+``serve.queue``/``serve.batcher`` never import jax; ``serve.server``
+defers the decoder import until it actually builds one, so admission
+and batching logic stay testable (and chaos-drivable) without a device.
+"""
+
+from __future__ import annotations
+
+from textsummarization_on_flink_tpu.serve.errors import (
+    ServeClosedError,
+    ServeError,
+    ServeOverloadError,
+)
+from textsummarization_on_flink_tpu.serve.queue import (
+    RequestQueue,
+    ServeFuture,
+    ServeRequest,
+)
+from textsummarization_on_flink_tpu.serve.batcher import (
+    MicroBatcher,
+    resolve_buckets,
+)
+
+__all__ = [
+    "MicroBatcher", "RequestQueue", "ServeClosedError", "ServeError",
+    "ServeFuture", "ServeOverloadError", "ServeRequest", "ServingServer",
+    "resolve_buckets",
+]
+
+
+def __getattr__(name: str):
+    # ServingServer lazily: serve.server imports pipeline.io (sockets,
+    # breakers) which light importers of this package don't need
+    if name == "ServingServer":
+        from textsummarization_on_flink_tpu.serve.server import ServingServer
+
+        return ServingServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

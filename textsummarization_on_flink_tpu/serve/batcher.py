@@ -1,0 +1,131 @@
+"""Dynamic micro-batcher: coalesce queued requests into device batches.
+
+The decode path dispatches ONE compiled program per batch
+(decode/beam_search.py), so serving throughput is set by how full each
+dispatch is and how few distinct shapes the jit cache must hold.  This
+module owns both levers:
+
+  * **Coalescing** — after the first request of a batch arrives, wait
+    up to ``serve_max_wait_ms`` for neighbors, up to ``serve_max_batch``
+    requests per dispatch (the FastSeq observation, PAPERS.md: most
+    sequence-generation serving wins are batching/dispatch engineering
+    around an unchanged model).
+  * **Shape buckets** — pad the batch's encoder axis to the smallest
+    ``serve_buckets`` entry covering its longest article (the
+    ``Batch(..., enc_steps=bucket)`` hook from data/batching.py), so a
+    short article never pays full ``max_enc_steps`` decode FLOPs and
+    the jit cache stays bounded at len(buckets) shapes — hits/misses
+    are visible in the existing ``decode/compile_cache_*_total``
+    counters (decode/beam_search.py).
+
+The device batch SHAPE is always ``hps.batch_size``: a short
+micro-batch is padded with repeats of its last example tagged
+``real_mask=False``, which the decoder already drops (the same
+contract as data/batcher.py trickle padding).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.config import HParams, parse_bucket_spec
+from textsummarization_on_flink_tpu.data.batching import Batch
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.serve.queue import (
+    RequestQueue,
+    ServeRequest,
+)
+
+
+def resolve_buckets(hps: HParams) -> List[int]:
+    """The ascending encoder-length bucket list for this job (the one
+    parser lives in config.parse_bucket_spec; see its docstring)."""
+    return parse_bucket_spec(hps.serve_buckets, hps.max_enc_steps)
+
+
+class MicroBatcher:
+    """Pull requests off a RequestQueue and pack them into Batches.
+
+    ``next_group`` implements the time/size coalescing policy;
+    ``build`` packs a group into a bucket-padded, static-shape Batch.
+    Single consumer by design (the ServingServer dispatch thread);
+    the queue itself is the thread-safe boundary.
+    """
+
+    def __init__(self, hps: HParams, vocab: Vocab, rqueue: RequestQueue,
+                 registry: Optional[obs.Registry] = None):
+        self._hps = hps
+        self._vocab = vocab
+        self._q = rqueue
+        self.max_batch = min(hps.serve_max_batch or hps.batch_size,
+                             hps.batch_size)
+        self._window = max(hps.serve_max_wait_ms, 0.0) / 1000.0
+        self.buckets = resolve_buckets(hps)
+        reg = registry if registry is not None else obs.registry_for(hps)
+        # fill is the headline batching metric: mean fill ~1 means the
+        # window is too short (or traffic too thin) and every dispatch
+        # pays full-batch device time for one article
+        self._h_fill = reg.histogram(
+            "serve/batch_fill",
+            buckets=[float(i) for i in range(1, hps.batch_size + 1)])
+        self._h_bucket = reg.histogram(
+            "serve/batch_bucket_len", buckets=[float(b) for b in self.buckets])
+        self._c_batches = reg.counter("serve/batches_total")
+        self._c_pad_rows = reg.counter("serve/pad_rows_total")
+
+    def bucket_for(self, enc_len: int) -> int:
+        """Smallest bucket covering `enc_len` (SummaryExample.build has
+        already truncated to max_enc_steps == buckets[-1])."""
+        for b in self.buckets:
+            if enc_len <= b:
+                return b
+        return self.buckets[-1]
+
+    def next_group(self, poll: float = 0.05) -> Optional[List[ServeRequest]]:
+        """The next micro-batch worth of requests, or None after an idle
+        `poll` seconds (the caller's loop re-checks its stop flag).
+
+        The window clock starts at the FIRST request of the group: a
+        request never waits more than ``serve_max_wait_ms`` for
+        neighbors on top of its own queue time."""
+        first = self._q.get(timeout=poll)
+        if first is None:
+            return None
+        group = [first]
+        window_ends = time.monotonic() + self._window
+        while len(group) < self.max_batch:
+            remaining = window_ends - time.monotonic()
+            if remaining <= 0:
+                # the window closed; grab whatever is ALREADY queued
+                # (free fill — no extra waiting), then ship
+                while len(group) < self.max_batch:
+                    req = self._q.get_nowait()
+                    if req is None:
+                        break
+                    group.append(req)
+                break
+            req = self._q.get(timeout=remaining)
+            if req is None:
+                break
+            group.append(req)
+        return group
+
+    def build(self, group: List[ServeRequest]) -> Batch:
+        """Pack a group into one static-shape Batch: encoder axis padded
+        to the group's bucket, batch axis padded to ``hps.batch_size``
+        with real_mask=False repeats."""
+        bucket = max(self.bucket_for(r.example.enc_len) for r in group)
+        examples = [r.example for r in group]
+        n_real = len(examples)
+        pad = self._hps.batch_size - n_real
+        if pad:
+            examples = examples + [examples[-1]] * pad
+            self._c_pad_rows.inc(pad)
+        mask = [i < n_real for i in range(self._hps.batch_size)]
+        self._h_fill.observe(n_real)
+        self._h_bucket.observe(bucket)
+        self._c_batches.inc()
+        return Batch(examples, self._hps, self._vocab, enc_steps=bucket,
+                     real_mask=mask)

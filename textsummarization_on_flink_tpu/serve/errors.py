@@ -1,0 +1,35 @@
+"""Typed failure vocabulary for the serving subsystem (ISSUE 4).
+
+Both errors subclass ``resilience.errors.ResilienceError`` so generic
+resilience handlers (and the pre-existing RuntimeError handlers above
+them) keep working:
+
+  * ``ServeOverloadError`` — the admission controller rejected the
+    request: the bounded queue is full, or the admission circuit
+    breaker is open and the request was shed before touching the queue
+    (the BreakerSink load-shedding semantics, RESILIENCE.md).  The
+    request was NEVER enqueued; the caller may retry with backoff.
+  * ``ServeClosedError`` — the server is stopping/stopped; submissions
+    are refused and any request still queued at hard-stop is rejected
+    with this.
+
+Import-light by design (no jax/numpy): callers catch these in
+admission paths that must stay cheap.
+"""
+
+from __future__ import annotations
+
+from textsummarization_on_flink_tpu.resilience.errors import ResilienceError
+
+
+class ServeError(ResilienceError):
+    """Base class for serving-subsystem failures."""
+
+
+class ServeOverloadError(ServeError):
+    """Admission control rejected the request (queue full / breaker
+    open); it was never enqueued.  Retry with backoff, or shed."""
+
+
+class ServeClosedError(ServeError):
+    """The serving server is stopped (or stopping); no new requests."""

@@ -1,0 +1,253 @@
+"""Thread-safe request queue + admission control for concurrent serving.
+
+The unit of work is a ``ServeRequest``: one summarization request
+(uuid, article, reference) already tokenized into a ``SummaryExample``,
+carrying the ``ServeFuture`` its caller blocks on and a ``Deadline``
+measured from *enqueue* (not batch start — time spent queued counts
+against the request's budget, RESILIENCE.md degradation contract).
+
+Admission control (``RequestQueue``): the queue depth is BOUNDED
+(``serve_max_queue``).  A non-blocking submit against a full queue is
+rejected with the typed ``ServeOverloadError`` — never silently dropped,
+never parked unbounded — and every rejection is a *failure* recorded
+against an admission ``CircuitBreaker``: under sustained overload the
+breaker opens and requests are shed immediately without touching the
+queue (the ``BreakerSink`` load-shedding semantics from pipeline/io.py,
+applied to the ingress side), then a half-open probe admission decides
+recovery.  Blocking submits (the pipeline-driving path) exert
+backpressure instead: they wait for space and bypass the breaker.
+
+Import-light: no jax; numpy only transitively via data.batching.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_lib
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.resilience.policy import (
+    CircuitBreaker,
+    Deadline,
+)
+from textsummarization_on_flink_tpu.serve.errors import (
+    ServeClosedError,
+    ServeOverloadError,
+)
+
+log = logging.getLogger(__name__)
+
+
+class ServeFuture:
+    """A per-request completion handle that resolves EXACTLY ONCE.
+
+    ``result(timeout)`` blocks for the ``DecodedResult`` (re-raising the
+    failure that rejected the request); ``add_done_callback`` runs the
+    callback on the resolving thread (or immediately when already done).
+    A second ``_resolve``/``_reject`` is a programming error and raises
+    — the exactly-once contract is load-bearing for the acceptance test
+    and for sinks that must see one row per request.
+    """
+
+    __slots__ = ("uuid", "_event", "_result", "_error", "_lock",
+                 "_callbacks", "_registry")
+
+    def __init__(self, uuid: str = "",
+                 registry: Optional[obs.Registry] = None):
+        self.uuid = uuid
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._callbacks: List[Callable[["ServeFuture"], None]] = []
+        self._registry = registry if registry is not None else obs.registry()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The rejection cause once done (None while pending / on
+        success) — lets callbacks route without a try/except."""
+        return self._error
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """The DecodedResult, blocking up to `timeout` seconds.  Raises
+        the rejection error verbatim, or TimeoutError on expiry."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"serve request {self.uuid!r} not resolved in {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def add_done_callback(self,
+                          fn: Callable[["ServeFuture"], None]) -> None:
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        self._run_callback(fn)
+
+    def _run_callback(self, fn: Callable[["ServeFuture"], None]) -> None:
+        try:
+            fn(self)
+        except Exception:  # a sink callback must never kill the dispatcher
+            self._registry.counter("serve/callback_errors_total").inc()
+            log.exception("serve future callback failed (uuid=%s)", self.uuid)
+
+    def _finish(self, result: Any, error: Optional[BaseException]) -> None:
+        with self._lock:
+            if self._event.is_set():
+                raise AssertionError(
+                    f"ServeFuture {self.uuid!r} resolved twice")
+            self._result = result
+            self._error = error
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            self._run_callback(fn)
+
+    def _resolve(self, result: Any) -> None:
+        self._finish(result, None)
+
+    def _reject(self, error: BaseException) -> None:
+        self._finish(None, error)
+
+
+class ServeRequest:
+    """One admitted (or about-to-be-admitted) summarization request."""
+
+    __slots__ = ("uuid", "article", "reference", "example", "future",
+                 "deadline", "enqueue_t")
+
+    def __init__(self, uuid: str, article: str, reference: str,
+                 example: Any, deadline: Optional[Deadline] = None,
+                 registry: Optional[obs.Registry] = None):
+        self.uuid = uuid
+        self.article = article
+        self.reference = reference
+        self.example = example  # data.batching.SummaryExample
+        self.future = ServeFuture(uuid, registry=registry)
+        # the budget runs from ENQUEUE: queue wait spends it, so a
+        # request that aged in a deep queue reaches the decoder with
+        # less room and degrades (or at worst expires) honestly
+        self.deadline = deadline if deadline is not None else Deadline.never()
+        self.enqueue_t = time.monotonic()
+
+
+class RequestQueue:
+    """Bounded FIFO of ServeRequests with breaker-backed admission.
+
+    Non-blocking ``submit``: breaker-gated; a full queue raises
+    ``ServeOverloadError`` and counts a breaker failure (consecutive
+    failures trip it open — subsequent submits shed immediately for
+    ``reset_secs`` without touching the queue).  Blocking ``submit``:
+    waits up to `timeout` for space (backpressure; no breaker
+    involvement) and raises ``ServeOverloadError`` only on timeout.
+
+    Metrics (serve/ namespace, SERVING.md): ``serve/queue_depth`` gauge,
+    ``serve/submitted_total`` / ``serve/shed_total`` counters, and the
+    admission breaker's ``resilience/serve.admission/*`` family.
+    """
+
+    def __init__(self, max_depth: int,
+                 breaker: Optional[CircuitBreaker] = None,
+                 registry: Optional[obs.Registry] = None):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self._q: "queue_lib.Queue[ServeRequest]" = queue_lib.Queue(
+            maxsize=max_depth)
+        reg = registry if registry is not None else obs.registry()
+        # under sustained overload there is no point probing the queue
+        # per request; a short reset window keeps shedding responsive
+        # to recovery while bounding the lock traffic of hot rejection
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            threshold=2 * max_depth, reset_secs=0.25,
+            name="serve.admission", registry=reg)
+        self._closed = False
+        self._g_depth = reg.gauge("serve/queue_depth")
+        self._c_submitted = reg.counter("serve/submitted_total")
+        self._c_shed = reg.counter("serve/shed_total")
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self._breaker
+
+    def close(self) -> None:
+        """Refuse all further submits (pending requests stay queued for
+        the drain; ``drain_reject`` empties them with typed errors)."""
+        self._closed = True
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def submit(self, req: ServeRequest, block: bool = False,
+               timeout: Optional[float] = None) -> None:
+        """Admit `req` or raise ``ServeOverloadError``/``ServeClosedError``.
+
+        The request's queue clock restarts here: admission time is when
+        the deadline-from-enqueue semantics begin for queue-wait
+        accounting."""
+        if self._closed:
+            raise ServeClosedError("serving queue is closed")
+        if not block and not self._breaker.allow():
+            self._c_shed.inc()
+            raise ServeOverloadError(
+                "request shed: admission breaker open (sustained overload)")
+        req.enqueue_t = time.monotonic()
+        try:
+            if block:
+                self._q.put(req, timeout=timeout)
+            else:
+                self._q.put_nowait(req)
+        except queue_lib.Full:
+            if not block:
+                self._breaker.record_failure()
+            self._c_shed.inc()
+            raise ServeOverloadError(
+                f"serve queue full (depth {self.max_depth}); request "
+                f"{req.uuid!r} rejected") from None
+        if not block:
+            self._breaker.record_success()
+        self._c_submitted.inc()
+        self._g_depth.set(self._q.qsize())
+
+    def get(self, timeout: float = 0.05) -> Optional[ServeRequest]:
+        """Next request, or None after `timeout` seconds idle."""
+        try:
+            req = self._q.get(timeout=timeout)
+        except queue_lib.Empty:
+            return None
+        self._g_depth.set(self._q.qsize())
+        return req
+
+    def get_nowait(self) -> Optional[ServeRequest]:
+        try:
+            req = self._q.get_nowait()
+        except queue_lib.Empty:
+            return None
+        self._g_depth.set(self._q.qsize())
+        return req
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def drain_reject(self, error: BaseException) -> int:
+        """Reject every still-queued request with `error` (hard stop);
+        returns the number rejected."""
+        n = 0
+        while True:
+            req = self.get_nowait()
+            if req is None:
+                return n
+            req.future._reject(error)
+            n += 1

@@ -1,0 +1,314 @@
+"""ServingServer: concurrent request serving over the decode path.
+
+The reference's whole point is *streaming* summarization (Kafka rows
+through Flink into TF and back out, App.java inference job), but the
+repo's decode loop was synchronous — one caller, one batch at a time
+(decode/decoder.py ``decode()``).  This module turns the decoder into a
+shared service:
+
+    server = ServingServer(hps, vocab, train_dir=...)   # or params=
+    with server:
+        fut = server.submit("some article text .", uuid="u1")
+        result = fut.result(timeout=30)                 # DecodedResult
+
+Many callers submit concurrently; ONE dispatch thread pulls
+micro-batches (serve/batcher.py) off the admission-controlled queue
+(serve/queue.py) and runs them through ``BeamSearchDecoder.decode_batch``
+— so independent requests share device dispatches (batch-fill > 1 under
+load) while the jit cache stays bounded by the shape buckets.
+
+Contracts:
+  * every admitted request resolves EXACTLY ONCE — with a
+    ``DecodedResult`` or with the typed error that killed its batch;
+  * per-request ``Deadline`` measured from enqueue: a batch dispatches
+    under the TIGHTEST deadline of its members, reusing the decoder's
+    beam->greedy degradation ladder (``_should_degrade``), degraded
+    results tagged and counted;
+  * checkpoint hot-swap happens BETWEEN batches via the decoder's
+    lock-guarded ``maybe_reload_checkpoint`` (never mid-dispatch);
+  * ``serve(source, sink)`` drives any pipeline/io.py Source/Sink pair
+    through the queue with blocking-submit backpressure — the
+    concurrency upgrade for ``pipeline/app.py:start_inference``.
+
+Observability (SERVING.md): serve/queue_depth, serve/time_in_queue_
+seconds, serve/batch_fill, serve/e2e_latency_seconds, serve/shed_total,
+serve/degraded_total, serve/errors_total.  Chaos: injection point
+``serve.dispatch`` fails whole batches deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, List, Optional, Sequence
+
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.batching import SummaryExample
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.pipeline.io import (
+    CollectionSink,
+    SchemaProjectionError,
+    Sink,
+    Source,
+)
+from textsummarization_on_flink_tpu.resilience import faultinject
+from textsummarization_on_flink_tpu.resilience.policy import Deadline
+from textsummarization_on_flink_tpu.serve.batcher import MicroBatcher
+from textsummarization_on_flink_tpu.serve.errors import (
+    ServeClosedError,
+    ServeOverloadError,
+)
+from textsummarization_on_flink_tpu.serve.queue import (
+    RequestQueue,
+    ServeFuture,
+    ServeRequest,
+)
+
+log = logging.getLogger(__name__)
+
+#: columns the serving path consumes from a pipeline row (the
+#: inference_selected_cols default, App.java:100)
+SERVE_COLS = ("uuid", "article", "reference")
+
+
+class ServingServer:
+    """Thread-safe concurrent serving front-end for one decoder.
+
+    Construct with ``params=`` (static weights) or ``train_dir=``
+    (checkpoint dir: continuous mode hot-swaps the newest checkpoint
+    between batches), or inject a prebuilt ``decoder=`` (tests, custom
+    wiring).  ``start()`` launches the dispatch thread; ``stop()``
+    drains the queue and joins (context-manager sugar does both).
+    """
+
+    def __init__(self, hps: HParams, vocab: Vocab,
+                 params: Optional[Any] = None,
+                 train_dir: Optional[str] = None,
+                 decoder: Optional[Any] = None,
+                 decode_root: Optional[str] = None,
+                 registry: Optional[obs.Registry] = None):
+        self._hps = hps
+        self._vocab = vocab
+        self._reg = registry if registry is not None else obs.registry_for(hps)
+        if decoder is None:
+            # deferred: decoder pulls in beam_search -> jax; a server
+            # built around an injected stub must not pay that import
+            from textsummarization_on_flink_tpu.decode.decoder import (
+                BeamSearchDecoder,
+            )
+
+            decoder = BeamSearchDecoder(
+                hps.replace(single_pass=False), vocab, batcher=None,
+                params=params, train_dir=train_dir, decode_root=decode_root)
+        self._decoder = decoder
+        self._queue = RequestQueue(hps.serve_max_queue, registry=self._reg)
+        self._batcher = MicroBatcher(hps, vocab, self._queue,
+                                     registry=self._reg)
+        self._faults = faultinject.plan_for(hps)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._h_queue_time = self._reg.histogram(
+            "serve/time_in_queue_seconds")
+        self._h_e2e = self._reg.histogram("serve/e2e_latency_seconds")
+        self._c_done = self._reg.counter("serve/completed_total")
+        self._c_degraded = self._reg.counter("serve/degraded_total")
+        self._c_errors = self._reg.counter("serve/errors_total")
+        self._c_rows_out = self._reg.counter("serve/sink_rows_total")
+
+    # -- lifecycle --
+    def start(self) -> "ServingServer":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-dispatch")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = 60.0) -> None:
+        """Refuse new submits, drain everything already admitted, join.
+
+        Every admitted request still resolves (the exactly-once
+        contract survives shutdown); only if the dispatcher fails to
+        drain within `timeout` are leftovers rejected with the typed
+        ``ServeClosedError``."""
+        self._queue.close()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():  # pragma: no cover - defensive
+                log.warning("serve dispatch thread still draining after "
+                            "%.0fs; rejecting leftovers", timeout or 0)
+        n = self._queue.drain_reject(
+            ServeClosedError("server stopped before this request ran"))
+        if n:
+            self._c_errors.inc(n)
+        self._thread = None
+
+    def __enter__(self) -> "ServingServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    # -- request API --
+    def submit(self, article: str, uuid: str = "", reference: str = "",
+               block: bool = False,
+               timeout: Optional[float] = None) -> ServeFuture:
+        """Admit one request; returns its future.
+
+        Non-blocking (default): full queue / open admission breaker
+        raises ``ServeOverloadError`` immediately — the caller sheds or
+        retries with backoff.  ``block=True`` waits up to `timeout` for
+        queue space instead (pipeline backpressure mode).
+
+        The per-request Deadline starts NOW (enqueue), so queue wait
+        spends the ``decode_deadline_secs`` budget and an aged request
+        degrades to greedy exactly like a slow one (RESILIENCE.md)."""
+        example = SummaryExample.build(
+            article, [], self._vocab, self._hps,
+            uuid=uuid, reference=reference)
+        req = ServeRequest(
+            uuid, article, reference, example,
+            deadline=Deadline.after(
+                getattr(self._hps, "decode_deadline_secs", 0.0)),
+            registry=self._reg)
+        self._queue.submit(req, block=block, timeout=timeout)
+        return req.future
+
+    def pending(self) -> int:
+        return self._queue.qsize()
+
+    # -- pipeline driving --
+    def serve(self, source: Source, sink: Optional[Sink] = None,
+              cols: Sequence[str] = SERVE_COLS, max_count: int = 0,
+              result_timeout: Optional[float] = 600.0) -> Sink:
+        """Drive a pipeline Source through the queue into a Sink.
+
+        Rows are projected to `cols` (uuid, article, reference) via the
+        source's schema, submitted with BLOCKING backpressure (a full
+        queue slows the feed instead of shedding pipeline rows), and
+        each result row (uuid, article, summary, reference) is written
+        to the sink the moment its future resolves — per-record
+        immediacy, the Issue-6 contract, but now out-of-order under
+        concurrency (rows are uuid-keyed by design).  Returns the sink
+        after every submitted row resolved; the first request failure
+        re-raises after the drain."""
+        out = sink if sink is not None else CollectionSink()
+        cols = list(cols)
+        try:
+            source.schema.select(cols)
+        except ValueError as e:
+            self._reg.counter("pipeline/feeder_errors_total").inc()
+            raise SchemaProjectionError(
+                f"source schema {source.schema!r} cannot provide serving "
+                f"columns {cols}") from e
+
+        def write_row(fut: ServeFuture) -> None:
+            if fut.error is None:
+                out.write(fut.result().as_row())
+                self._c_rows_out.inc()
+
+        futures: List[ServeFuture] = []
+        n = 0
+        for row in source.rows():
+            try:
+                uuid, article, reference = source.schema.project_row(
+                    row, cols)
+            except (IndexError, ValueError) as e:
+                self._reg.counter("pipeline/feeder_errors_total").inc()
+                raise SchemaProjectionError(
+                    f"row {row!r} does not match schema "
+                    f"{source.schema!r}") from e
+            fut = self.submit(str(article), uuid=str(uuid),
+                              reference=str(reference), block=True)
+            fut.add_done_callback(write_row)
+            futures.append(fut)
+            n += 1
+            if max_count and n >= max_count:
+                break
+        first_error: Optional[BaseException] = None
+        for fut in futures:
+            try:
+                fut.result(timeout=result_timeout)
+            except Exception as e:  # noqa: PERF203  # tslint: disable=TS005 — deferred re-raise: the first failure is raised after ALL futures drain; counting here would double serve/errors_total (the rejection site already counted)
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+        return out
+
+    # -- dispatch loop --
+    def _tightest_deadline(self, group: List[ServeRequest]) -> Deadline:
+        """The batch runs under the most urgent member's budget: one
+        dispatch serves them all, so the least headroom decides whether
+        the whole batch degrades to greedy."""
+        bounded = [r.deadline for r in group if r.deadline.bounded]
+        if not bounded:
+            return Deadline.never()
+        return min(bounded, key=lambda d: d.remaining())
+
+    def _run(self) -> None:
+        t_last = time.monotonic()
+        while True:
+            group = self._batcher.next_group()
+            if group is None:
+                if self._stop.is_set() and self._queue.empty():
+                    return
+                continue
+            self._dispatch(group)
+            if self._stop.is_set() and self._queue.empty():
+                return
+            try:
+                # hot-swap strictly BETWEEN batches; the decoder's param
+                # lock makes the (params, ckpt_name) swap atomic even
+                # against out-of-band decode_batch callers
+                t_last = self._decoder.maybe_reload_checkpoint(t_last)
+            except Exception:
+                # a failed reload must not kill the dispatch thread —
+                # that would hang every queued and future request; the
+                # decoder keeps serving its current params and the next
+                # reload window retries
+                self._reg.counter("serve/ckpt_reload_errors_total").inc()
+                log.exception("between-batch checkpoint reload failed; "
+                              "continuing on current params")
+                t_last = time.monotonic()
+
+    def _dispatch(self, group: List[ServeRequest]) -> None:
+        now = time.monotonic()
+        for r in group:
+            self._h_queue_time.observe(now - r.enqueue_t)
+        try:
+            with obs.spans.span(self._reg, "serve/dispatch",
+                                fill=len(group)):
+                if self._faults.fire("serve.dispatch"):
+                    raise RuntimeError("injected serve.dispatch fault")
+                batch = self._batcher.build(group)
+                results = self._decoder.decode_batch(
+                    batch, deadline=self._tightest_deadline(group))
+            if len(results) != len(group):
+                raise RuntimeError(
+                    f"decoder returned {len(results)} results for "
+                    f"{len(group)} real rows (real_mask drift?)")
+        except Exception as e:
+            # a failed dispatch fails ITS batch only — each member
+            # resolves exactly once with the typed cause; the server
+            # lives on to serve the next group
+            self._c_errors.inc(len(group))
+            log.exception("serve dispatch failed; rejecting %d request(s)",
+                          len(group))
+            for r in group:
+                r.future._reject(e)
+            return
+        done_t = time.monotonic()
+        for r, res in zip(group, results):
+            if getattr(res, "degraded", False):
+                self._c_degraded.inc()
+            self._h_e2e.observe(done_t - r.enqueue_t)
+            self._c_done.inc()
+            r.future._resolve(res)
+
+
+__all__ = ["ServingServer", "ServeFuture", "ServeOverloadError",
+           "ServeClosedError", "SERVE_COLS"]
