@@ -44,13 +44,22 @@ Modes (BENCH_MODE):
                     -> future resolved, queue wait included), mean
                     batch fill, and requests/sec.  `python bench.py
                     --serve` is shorthand for BENCH_MODE=serve.
+  bytes           — XLA cost-analysis byte accounting for the train
+                    step (no execution; CPU-forced like input mode):
+                    bytes accessed + intensity for the baseline config
+                    and each byte-diet lever (--loss_chunk streaming
+                    loss, bf16 optimizer state, both), with per-lever
+                    reduction ratios.  The CPU-verifiable side of the
+                    PERF.md "Byte diet" claims.
 
 Env overrides: BENCH_STEPS (20), BENCH_BATCH (16),
 BENCH_PRESET=tiny|scaled (smoke scale / the BASELINE configs[3]
 hidden-512 enc-800 shape), BENCH_FAMILY=transformer (bench the
 second model family), BENCH_FLASH_T (flash-mode sequence length),
 BENCH_SPD (trainer-mode steps_per_dispatch, 8), BENCH_UNROLL
-(scan_unroll override), BENCH_TIMEOUT (600s per attempt),
+(scan_unroll override), BENCH_LOSS_CHUNK (streaming-loss chunk; train/
+trainer/bytes modes), BENCH_OPT_DTYPE (Adagrad accumulator storage
+dtype), BENCH_TIMEOUT (600s per attempt),
 BENCH_ATTEMPTS (2), BENCH_PLATFORM=cpu (force CPU child for smoke
 runs), BENCH_PEAK_TFLOPS (override the per-chip bf16 peak used for
 MFU).
@@ -96,6 +105,7 @@ _METRIC_BY_MODE = {
     "flash": "flash_attention_speedup_vs_xla",
     "input": "input_pipeline_samples_per_sec",
     "serve": "serve_e2e_p50_latency_ms",
+    "bytes": "train_step_bytes_accessed",
 }
 
 
@@ -118,8 +128,9 @@ def _child_env() -> dict:
         env["TS_OBS_SNAPSHOT"] = "1"
     repo_root = os.path.dirname(os.path.abspath(__file__))
     set_default_compile_cache(env)
-    if env.get("BENCH_MODE") == "input":
-        # host-only mode: never let a down TPU tunnel hang the child
+    if env.get("BENCH_MODE") in ("input", "bytes"):
+        # host-only modes (bytes = XLA cost analysis, backend-portable by
+        # design): never let a down TPU tunnel hang the child
         env["BENCH_PLATFORM"] = "cpu"
     if env.get("BENCH_PLATFORM", "").lower() == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
@@ -161,12 +172,40 @@ def _config_fingerprint() -> dict:
     mode = os.environ.get("BENCH_MODE", "train")
     fp = {"mode": mode}
     # a CPU smoke record must never stand in for a TPU ask (or vice
-    # versa); input mode is host-only by construction
-    if mode == "input":
+    # versa); input/bytes modes are host-only by construction
+    if mode in ("input", "bytes"):
         fp["platform"] = "cpu"
     else:
         fp["platform"] = (os.environ.get("BENCH_PLATFORM", "").lower()
                           or "tpu")
+    if mode in ("train", "trainer"):
+        # byte-diet lever axes (ISSUE 5): each is a DIFFERENT compiled
+        # program, so rows must never cross-substitute.  Added only when
+        # non-default so pre-existing banked records (no such keys) keep
+        # matching default asks.
+        chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0"))
+        if chunk:
+            fp["loss_chunk"] = chunk
+        opt_dtype = os.environ.get("BENCH_OPT_DTYPE", "") or "float32"
+        if opt_dtype != "float32":
+            fp["opt_dtype"] = opt_dtype
+    if mode == "bytes":
+        # the bytes child sweeps the opt-dtype lever internally (and
+        # BENCH_LOSS_CHUNK only picks the swept chunk size, carried as
+        # "chunk"), so neither train-mode lever axis applies here — a
+        # duplicate axis would split identical records across
+        # fingerprints and defeat incremental banking
+        fp["batch"] = int(os.environ.get("BENCH_BATCH", "16"))
+        fp["preset"] = os.environ.get("BENCH_PRESET", "ref") or "ref"
+        fp["family"] = (os.environ.get("BENCH_FAMILY", "")
+                        or "pointer_generator")
+        fp["chunk"] = int(os.environ.get("BENCH_LOSS_CHUNK", "25"))
+        # remat/unroll reach the compiled programs via _preset_overrides
+        # (e.g. an exported BENCH_REMAT=1 from a sweep): different
+        # programs, different record — same rule as train mode
+        fp["remat"] = _env_flag("BENCH_REMAT")
+        if os.environ.get("BENCH_UNROLL"):
+            fp["unroll"] = int(os.environ["BENCH_UNROLL"])
     if mode in ("train", "trainer", "decode"):
         fp["batch"] = int(os.environ.get(
             "BENCH_BATCH", "4" if mode == "decode" else "16"))
@@ -258,12 +297,15 @@ _digest_cache: dict = {}
 
 
 def _file_digest(path: str) -> str:
-    """Short content digest of a fixture file, cached on (size, mtime)
-    so the per-row sweep liveness checks don't re-hash tens of MB."""
+    """Short content digest of a fixture file, cached on
+    (size, mtime_ns) so the per-row sweep liveness checks don't re-hash
+    tens of MB.  Nanosecond mtime (advisor r5 #3): a same-second,
+    same-size fixture regen must invalidate the cache, not serve the
+    previous content's digest."""
     import hashlib
 
     st = os.stat(path)
-    key = (path, st.st_size, int(st.st_mtime))
+    key = (path, st.st_size, st.st_mtime_ns)
     if key not in _digest_cache:
         h = hashlib.sha256()
         with open(path, "rb") as f:
@@ -601,6 +643,13 @@ def _preset_overrides() -> dict:
         out.update(hidden_dim=512, max_enc_steps=800)
     if os.environ.get("BENCH_UNROLL"):
         out["scan_unroll"] = int(os.environ["BENCH_UNROLL"])
+    if os.environ.get("BENCH_LOSS_CHUNK"):
+        # streaming chunked vocab loss (ISSUE 5 byte diet): the
+        # [T_dec, B, V] scores tensor never materializes
+        out["loss_chunk"] = int(os.environ["BENCH_LOSS_CHUNK"])
+    if os.environ.get("BENCH_OPT_DTYPE"):
+        # bf16 Adagrad accumulator storage (half the optimizer-state HBM)
+        out["opt_state_dtype"] = os.environ["BENCH_OPT_DTYPE"]
     if _env_flag("BENCH_REMAT"):
         # roofline-motivated A/B (BASELINE.md): on a bandwidth-bound step
         # recomputing the [T_dec, B, V] scores block in backward may SAVE
@@ -1244,6 +1293,100 @@ def bench_serve() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_bytes() -> None:
+    """BENCH_MODE=bytes: roofline byte accounting for the train step from
+    XLA's own cost model — runnable on CPU with the TPU tunnel down
+    (cost_analysis is computed from the optimized HLO, no execution).
+
+    Compiles the REAL train step at the ask's scale (BENCH_PRESET /
+    BENCH_BATCH / BENCH_FAMILY) for the baseline config and each
+    byte-diet lever (PERF.md "Byte diet"):
+
+      * ``loss_chunk``  — streaming chunked vocab loss
+        (--loss_chunk=BENCH_LOSS_CHUNK, default 25);
+      * ``opt_bf16``    — bf16 Adagrad accumulator storage;
+      * ``combined``    — both levers together;
+
+    and reports bytes accessed, arithmetic intensity, and each lever's
+    reduction vs baseline.  The dp gradient all-reduce lever is reported
+    analytically (collective bytes = gradient-tree bytes per step, halved
+    by the bf16 wire dtype) — cost_analysis never sees collectives on a
+    single-device compile.  The headline value is the BASELINE config's
+    bytes/step; reduction_* fields carry the lever claims the byte-budget
+    gate (BYTE_BUDGET.json, tests/test_bytes_gate.py) enforces in tier-1.
+    """
+    import jax
+
+    from textsummarization_on_flink_tpu.config import HParams
+    from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+    from __graft_entry__ import train_step_cost as cost_of
+
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
+    chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "25"))
+    overrides = _preset_overrides()
+    overrides.pop("loss_chunk", None)  # the lever axis is swept below
+    overrides.pop("opt_state_dtype", None)
+    hps0 = HParams(batch_size=batch, compute_dtype="bfloat16", **overrides)
+
+    configs = {
+        "baseline": hps0,
+        "loss_chunk": hps0.replace(loss_chunk=chunk),
+        "opt_bf16": hps0.replace(opt_state_dtype="bfloat16"),
+        "combined": hps0.replace(loss_chunk=chunk,
+                                 opt_state_dtype="bfloat16"),
+    }
+    costs = {}
+    for name, hps in configs.items():
+        sys.stderr.write(f"[bytes] compiling {name} ...\n")
+        costs[name] = cost_of(hps)
+    base = costs["baseline"]["bytes"]
+    # analytic collective bytes: one all-reduce of the full gradient tree
+    # per step (2x on the wire for a ring, but the RATIO is what matters)
+    state = jax.eval_shape(lambda: trainer_lib.init_train_state(
+        hps0, hps0.vocab_size, seed=0))
+    grad_elems = sum(int(np.prod(x.shape))
+                     for x in jax.tree_util.tree_leaves(state.params))
+    _, info = _device_info()
+    rec = {
+        "metric": "train_step_bytes_accessed",
+        "value": base,
+        "unit": "bytes",
+        "vs_baseline": 0.0,  # the reference publishes no byte accounting
+        "levers": {
+            name: {
+                "bytes": c["bytes"],
+                "flops": c["flops"],
+                "temp_bytes": c["temp_bytes"],
+                "intensity_flops_per_byte": round(
+                    c["flops"] / max(c["bytes"], 1.0), 2),
+                "reduction_vs_baseline": round(1.0 - c["bytes"] / base, 4),
+            } for name, c in costs.items()
+        },
+        "reduction_loss_chunk": round(
+            1.0 - costs["loss_chunk"]["bytes"] / base, 4),
+        "reduction_opt_bf16": round(
+            1.0 - costs["opt_bf16"]["bytes"] / base, 4),
+        "reduction_combined": round(
+            1.0 - costs["combined"]["bytes"] / base, 4),
+        "grad_allreduce_bytes_f32": 4 * grad_elems,
+        "grad_allreduce_bytes_bf16": 2 * grad_elems,
+        "loss_chunk": chunk,
+        "batch": batch,
+        "model_family": hps0.model_family,
+        "note": "XLA cost_analysis on the optimized HLO (CPU-compiled; "
+                "no execution).  Caveats: bytes depend on fusion "
+                "decisions, and HloCostAnalysis counts a loop BODY once "
+                "(both configs' decoder scans are counted once, so that "
+                "cancels in the ratio, but the chunked loss scan's "
+                "per-chunk traffic is also single-counted) — treat the "
+                "ratios as the cost-model claim; temp_bytes (peak live "
+                "temp from memory_analysis) is the loop-independent "
+                "evidence the scores value+residual are gone",
+    }
+    rec.update(info)
+    print(json.dumps(rec))
+
+
 def bench_trainer() -> None:
     """BENCH_MODE=trainer: END-TO-END production-path training
     throughput — the real Trainer.train() over the threaded bucketing
@@ -1342,6 +1485,8 @@ def child_main() -> None:
         bench_trainer()
     elif mode == "serve":
         bench_serve()
+    elif mode == "bytes":
+        bench_bytes()
     elif mode == "train":
         bench_train()
     else:
@@ -1350,7 +1495,7 @@ def child_main() -> None:
                           "retryable": False,
                           "error": f"unknown BENCH_MODE={mode!r} (train/"
                                    f"trainer/decode/attention/flash/input/"
-                                   f"serve)"}))
+                                   f"serve/bytes)"}))
         sys.exit(2)
 
 

@@ -6,10 +6,11 @@
 #
 #     scripts/bench_all.sh [out.jsonl]
 #
-# Runs: train at reference batch 16 (with Pallas-kernel and unroll=1
-# A/B rows), train at batch 64, train scaled (hidden 512 / enc 800),
-# transformer-family train, decode latency for BOTH families,
-# attention + flash kernel A/Bs, host input pipeline.
+# Runs: train at reference batch 16 (with Pallas-kernel, unroll, remat
+# and byte-diet A/B rows), train at batch 64, train scaled (hidden 512 /
+# enc 800), transformer-family train, decode latency for BOTH families,
+# attention + flash kernel A/Bs, host input pipeline, and the CPU-only
+# cost-analysis byte accounting (BENCH_MODE=bytes).
 set -uo pipefail
 
 OUT="${1:-BENCH_ALL.jsonl}"
@@ -121,25 +122,51 @@ PYEOF
   # on CPU and the dead-tunnel early-abort would never fire
   if [ -z "$line" ]; then
     echo "{\"run\": \"$tag\", \"error\": \"no output\"}" >> "$OUT"
-  elif printf '%s\n' "$line" | env PYTHONPATH= python -c "
-import json,sys
-rec = json.loads(sys.stdin.read())
-sys.exit(0 if ('error' in rec or rec.get('stale')) else 1)" 2>/dev/null; then
+  else
+    # classify the child's last line (advisor r5 #4): 0 = error/stale
+    # record, 1 = live measurement, 2 = unparseable.  A crashed
+    # classifier used to read as "live" and arm the denominator pairing
+    # off garbage.
     printf '%s\n' "$line" | env PYTHONPATH= python -c "
+import json,sys
+try:
+    rec = json.loads(sys.stdin.read())
+except ValueError:
+    sys.exit(2)
+if not isinstance(rec, dict) or 'metric' not in rec:
+    sys.exit(2)
+sys.exit(0 if ('error' in rec or rec.get('stale')) else 1)" 2>/dev/null
+    case $? in
+      0)
+        printf '%s\n' "$line" | env PYTHONPATH= python -c "
 import json,sys
 rec = json.loads(sys.stdin.read()); rec['run'] = '$tag'
 print(json.dumps(rec))" >> "$OUT"
-  else
-    # a LIVE measurement banked (only this arms the paired-denominator
-    # re-measure — an error/stale row pairs with nothing)
-    DID_MEASURE=1
-    if ! grep -qF "$line" "$OUT"; then
-      # bench.py appends successes itself, printing the identical JSON it
-      # recorded — if the line is missing, the self-append failed (its
-      # stderr warning was discarded above); do not lose the measurement
-      echo "[sweep] self-append missing for '$tag'; appending fallback" >&2
-      printf '%s\n' "$line" >> "$OUT"
-    fi
+        ;;
+      1)
+        # a LIVE measurement banked (only this arms the paired-denominator
+        # re-measure — an error/stale/unparseable row pairs with nothing)
+        DID_MEASURE=1
+        if ! grep -qF "$line" "$OUT"; then
+          # bench.py appends successes itself, printing the identical JSON
+          # it recorded — if the line is missing, the self-append failed
+          # (its stderr warning was discarded above); do not lose the
+          # measurement
+          echo "[sweep] self-append missing for '$tag'; appending fallback" >&2
+          printf '%s\n' "$line" >> "$OUT"
+        fi
+        ;;
+      *)
+        # garbage on stdout (partial write, interleaved noise): append a
+        # typed error stub — never the raw line, which would poison the
+        # JSONL for every downstream reader — and leave DID_MEASURE alone
+        echo "[sweep] unparseable bench output for '$tag'" >&2
+        env PYTHONPATH= python -c "
+import json,sys
+print(json.dumps({'run': sys.argv[1],
+                  'error': 'unparseable bench output'}))" "$tag" >> "$OUT"
+        ;;
+    esac
   fi
   # a timed-out row usually means the tunnel died mid-sweep; probe once
   # and abort the pass early if so (the watcher retries the whole pass —
@@ -152,6 +179,17 @@ print(json.dumps(rec))" >> "$OUT"
     fi
   fi
 }
+
+# Test hook (tests/test_bench_scripts.py): exercise run()'s
+# classification/append contract on ONE row against a stubbed bench.py,
+# then report whether the row armed the denominator pairing — instead of
+# running the sweep.  The hook keeps the tested code EXACTLY the shipped
+# run()/pair_denominator definitions above.
+if [ -n "${BENCH_SWEEP_SINGLE:-}" ]; then
+  run "$BENCH_SWEEP_SINGLE"
+  echo "DID_MEASURE=$DID_MEASURE"
+  exit 0
+fi
 
 # Ordered by value-per-minute of a (possibly short) tunnel window: the
 # two headline numbers first (train throughput, decode serving latency),
@@ -176,19 +214,33 @@ run decode_chunked       BENCH_MODE=decode TS_BEAM_LOOP=chunked BENCH_TIMEOUT=12
 run decode_while         BENCH_MODE=decode TS_BEAM_LOOP=while BENCH_TIMEOUT=1200
 pair_denominator decode_b4 BENCH_MODE=decode BENCH_TIMEOUT=1200
 run decode_transformer   BENCH_MODE=decode BENCH_FAMILY=transformer BENCH_TIMEOUT=1200
-# --- train A/B lever rows, ratioed against train_b16
+# --- train A/B lever rows, ratioed against train_b16.  EVERY row whose
+# PERF.md band is stated against train_b16 sits before the
+# pair_denominator call (advisor r5 #1: train_scaled and
+# trainer_e2e_spd1 used to bank after it, so their ratios could pair
+# with a days-old denominator from a different tunnel window).
 DID_MEASURE=0
 run train_b16_unroll1    BENCH_MODE=train BENCH_UNROLL=1
 run train_b16_unroll16   BENCH_MODE=train BENCH_UNROLL=16
 run train_b16_pallas     BENCH_MODE=train TS_PALLAS=on
 run train_b16_remat      BENCH_MODE=train BENCH_REMAT=1
+run train_b16_losschunk  BENCH_MODE=train BENCH_LOSS_CHUNK=25
+run train_b16_bytediet   BENCH_MODE=train BENCH_LOSS_CHUNK=25 BENCH_OPT_DTYPE=bfloat16
 run train_b64            BENCH_MODE=train BENCH_BATCH=64
-pair_denominator train_b16 BENCH_MODE=train
 run train_scaled         BENCH_MODE=train BENCH_PRESET=scaled
-run train_transformer_flash BENCH_MODE=train BENCH_FAMILY=transformer TS_FLASH=on
 run trainer_e2e_spd1     BENCH_MODE=trainer BENCH_SPD=1
+pair_denominator train_b16 BENCH_MODE=train
+# --- transformer lever row, ratioed against train_transformer (advisor
+# r5 #1: the flash A/B needs its own same-window denominator pairing)
+DID_MEASURE=0
+run train_transformer_flash BENCH_MODE=train BENCH_FAMILY=transformer TS_FLASH=on
+pair_denominator train_transformer BENCH_MODE=train BENCH_FAMILY=transformer
 run attention_ab         BENCH_MODE=attention
 run flash_ab             BENCH_MODE=flash
 run input_pipeline       BENCH_MODE=input
+# host-only byte accounting (PERF.md byte diet): compiles ref-scale
+# cost-analysis programs on CPU — long first compile, so it gets its own
+# generous cap; a down tunnel cannot affect it (CPU-forced child)
+run bytes_cpu            BENCH_MODE=bytes BENCH_TIMEOUT=3600
 
 echo "wrote $(wc -l < "$OUT") records to $OUT" >&2

@@ -120,7 +120,11 @@ PYEOF
           echo "[watch] $(date -u +%H:%M:%S) re-bank probe..."
           if probe; then
             bank_row train_b16 train 300
-            bank_row decode_b4 decode 600
+            # 1200s to match bench_all.sh's decode rows (advisor r5 #2):
+            # a cold first compile exceeds 600s, and a child killed
+            # mid-compile writes nothing to the persistent compile cache
+            # — decode re-banking would then starve on every window
+            bank_row decode_b4 decode 1200
             # stale fallbacks are printed, never self-appended, so the
             # file only ever gains LIVE re-measurements here
             if ! git diff --quiet -- BENCH_ALL.jsonl; then

@@ -67,10 +67,28 @@ CONFIGS = {
     "train_transformer": {"BENCH_FAMILY": "transformer"},
     "train_tiny": {"BENCH_PRESET": "tiny", "BENCH_BATCH": "4",
                    "BENCH_UNROLL": "1"},
+    # byte-diet lever rows (ISSUE 5, PERF.md "Byte diet"): streaming
+    # chunked vocab loss, bf16 optimizer state, and both together — the
+    # roofline's bytes column is the CPU-verifiable side of each claim
+    "train_b16_losschunk": {"BENCH_LOSS_CHUNK": "25"},
+    "train_b16_optbf16": {"BENCH_OPT_DTYPE": "bfloat16"},
+    "train_b16_bytediet": {"BENCH_LOSS_CHUNK": "25",
+                           "BENCH_OPT_DTYPE": "bfloat16"},
+    "train_transformer_losschunk": {"BENCH_FAMILY": "transformer",
+                                    "BENCH_LOSS_CHUNK": "25"},
 }
 
 _BENCH_ENV_VARS = ("BENCH_BATCH", "BENCH_PRESET", "BENCH_FAMILY",
-                   "BENCH_UNROLL", "BENCH_REMAT")
+                   "BENCH_UNROLL", "BENCH_REMAT", "BENCH_LOSS_CHUNK",
+                   "BENCH_OPT_DTYPE")
+
+# lever row -> the config its byte reduction is measured against
+_BYTE_DIET_BASELINES = {
+    "train_b16_losschunk": "train_b16",
+    "train_b16_optbf16": "train_b16",
+    "train_b16_bytediet": "train_b16",
+    "train_transformer_losschunk": "train_transformer",
+}
 
 
 def hps_for(tag: str, bench_mod):
@@ -101,16 +119,13 @@ def _load_bench():
 
 
 def cost_of_train_step(hps):
-    """Compile the real train step and return XLA's {flops, bytes}."""
-    import numpy as np
+    """Compile the real train step and return XLA's {flops, bytes,
+    temp_bytes} — through the ONE shared compile-and-read helper
+    (__graft_entry__.train_step_cost), same as bench.py's bytes mode and
+    the tier-1 byte gate."""
+    from __graft_entry__ import train_step_cost
 
-    from textsummarization_on_flink_tpu.train import trainer as trainer_lib
-    from __graft_entry__ import _example_arrays
-
-    state = trainer_lib.init_train_state(hps, hps.vocab_size, seed=0)
-    step = trainer_lib.make_train_step(hps)
-    arrays = _example_arrays(hps, np.random.RandomState(0))
-    return _cost_of(step, state, arrays)
+    return train_step_cost(hps)
 
 
 def analyze(tag: str, chip: str, bench_mod, measured: dict | None):
@@ -232,7 +247,9 @@ def measured_rows(path: str) -> dict:
 def main(argv=None):
     ap = argparse.ArgumentParser()
     default_cfgs = ("train_b16,train_b16_remat,train_b64,train_scaled,"
-                    "train_transformer")
+                    "train_transformer,train_b16_losschunk,"
+                    "train_b16_optbf16,train_b16_bytediet,"
+                    "train_transformer_losschunk")
     ap.add_argument("--configs", default=default_cfgs)
     ap.add_argument("--chip", default="v5e", choices=sorted(CHIPS))
     ap.add_argument("--json", action="store_true")
@@ -277,6 +294,15 @@ def main(argv=None):
               f"{r['bytes_accessed'] / 1e9:>7.2f} "
               f"{r['min_step_ms']:>8.2f} "
               f"{r['max_samples_per_sec']:>9.0f} {meas:>9}")
+    by_tag = {r["config"]: r for r in out}
+    diet_rows = [(tag, base) for tag, base in _BYTE_DIET_BASELINES.items()
+                 if tag in by_tag and base in by_tag]
+    if diet_rows:
+        print("\nbyte-diet reductions (bytes accessed vs baseline config):")
+        for tag, base in diet_rows:
+            red = 1.0 - (by_tag[tag]["bytes_accessed"]
+                         / by_tag[base]["bytes_accessed"])
+            print(f"  {tag:<28} vs {base:<18} {red * 100:>6.1f}%")
     for r in out:
         if "attribution" in r:
             print(f"\n{r['config']} phase split (GB accessed / GFLOP):")

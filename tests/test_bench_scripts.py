@@ -1,0 +1,149 @@
+"""Bench-script contract tests (ISSUE 5 satellites; advisor r5 #3/#4).
+
+scripts/bench_all.sh's run() classifies the bench child's last stdout
+line and routes it into BENCH_ALL.jsonl; a bug here silently poisons the
+sweep record every sweep.  The BENCH_SWEEP_SINGLE hook in the script
+exercises ONE run() invocation — the exact shipped function — against a
+stubbed bench.py whose output the test controls, asserting the
+exit-code/append/DID_MEASURE contract for live JSON, stale JSON, error
+JSON, and garbage.  Plus the bench._file_digest same-second-regen
+regression (cache key must include st_mtime_ns).
+"""
+
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+spec = importlib.util.spec_from_file_location(
+    "bench_digest_under_test", os.path.join(REPO, "bench.py"))
+bench = importlib.util.module_from_spec(spec)
+sys.modules["bench_digest_under_test"] = bench
+spec.loader.exec_module(bench)
+
+# A stub bench.py honoring the pieces run() touches: importable with a
+# _config_fingerprint (the liveness check imports it), prints
+# FAKE_BENCH_OUTPUT verbatim when executed.  It deliberately does NOT
+# self-append, so the test can observe run()'s own append decisions.
+STUB_BENCH = '''
+import os, sys
+
+
+def _config_fingerprint():
+    return {"mode": os.environ.get("BENCH_MODE", "train")}
+
+
+if __name__ == "__main__":
+    out = os.environ.get("FAKE_BENCH_OUTPUT", "")
+    if out:
+        sys.stdout.write(out + "\\n")
+'''
+
+
+def _sandbox(tmp_path):
+    scripts = tmp_path / "repo" / "scripts"
+    scripts.mkdir(parents=True)
+    for name in ("bench_all.sh", "bench_latest.py"):
+        shutil.copy(os.path.join(REPO, "scripts", name), scripts / name)
+    (tmp_path / "repo" / "bench.py").write_text(STUB_BENCH)
+    return tmp_path / "repo"
+
+
+def _run_single(repo, tag, fake_output):
+    env = dict(os.environ)
+    env.update(PYTHONPATH="", BENCH_SWEEP_SINGLE=tag,
+               FAKE_BENCH_OUTPUT=fake_output)
+    proc = subprocess.run(["bash", "scripts/bench_all.sh"], cwd=repo,
+                          env=env, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    out_path = repo / "BENCH_ALL.jsonl"
+    lines = [json.loads(s)
+             for s in out_path.read_text().strip().splitlines() if s]
+    did_measure = None
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("DID_MEASURE="):
+            did_measure = int(ln.split("=", 1)[1])
+    assert did_measure is not None, proc.stdout[-500:]
+    return lines, did_measure, proc
+
+
+def test_live_json_arms_did_measure_and_lands_in_jsonl(tmp_path):
+    repo = _sandbox(tmp_path)
+    live = json.dumps({"metric": "m", "value": 1.5, "unit": "x",
+                       "vs_baseline": 1.0})
+    lines, did_measure, proc = _run_single(repo, "row_a", live)
+    assert did_measure == 1
+    # the stub never self-appends, so run()'s fallback append must fire
+    assert "self-append missing" in proc.stderr
+    assert len(lines) == 1 and lines[0]["value"] == 1.5
+
+
+def test_stale_json_appends_tagged_and_does_not_arm(tmp_path):
+    repo = _sandbox(tmp_path)
+    stale = json.dumps({"metric": "m", "value": 2.0, "unit": "x",
+                        "vs_baseline": 1.0, "stale": True})
+    lines, did_measure, _ = _run_single(repo, "row_b", stale)
+    assert did_measure == 0
+    assert len(lines) == 1
+    assert lines[0]["stale"] is True and lines[0]["run"] == "row_b"
+
+
+def test_error_json_appends_tagged_and_does_not_arm(tmp_path):
+    repo = _sandbox(tmp_path)
+    err = json.dumps({"metric": "m", "value": 0.0, "unit": "n/a",
+                      "vs_baseline": 0.0, "error": "boom"})
+    lines, did_measure, _ = _run_single(repo, "row_c", err)
+    assert did_measure == 0
+    assert len(lines) == 1
+    assert lines[0]["error"] == "boom" and lines[0]["run"] == "row_c"
+
+
+@pytest.mark.parametrize("garbage", [
+    "Traceback (most recent call last):",   # not JSON at all
+    '["metric", "not-a-dict"]',             # JSON but not an object
+    '{"value": 1.0}',                       # object but no metric field
+])
+def test_garbage_appends_error_stub_never_the_raw_line(tmp_path, garbage):
+    """advisor r5 #4: unparseable child output must become a typed error
+    stub — never the raw garbage line (which would poison the JSONL for
+    every reader) and never a live classification (which would arm the
+    denominator pairing off nothing)."""
+    repo = _sandbox(tmp_path)
+    lines, did_measure, proc = _run_single(repo, "row_d", garbage)
+    assert did_measure == 0
+    assert "unparseable" in proc.stderr
+    assert len(lines) == 1
+    assert lines[0] == {"run": "row_d", "error": "unparseable bench output"}
+    assert garbage not in (repo / "BENCH_ALL.jsonl").read_text()
+
+
+def test_empty_output_appends_no_output_stub(tmp_path):
+    repo = _sandbox(tmp_path)
+    lines, did_measure, _ = _run_single(repo, "row_e", "")
+    assert did_measure == 0
+    assert lines == [{"run": "row_e", "error": "no output"}]
+
+
+def test_file_digest_same_second_same_size_regen(tmp_path):
+    """advisor r5 #3: a regenerated fixture with the same byte size in
+    the same mtime SECOND must get a fresh digest — the cache key
+    includes st_mtime_ns, not the truncated-second mtime."""
+    fx = tmp_path / "fixture.npz"
+    fx.write_bytes(b"fixture content A")
+    os.utime(fx, ns=(1_000_000_000, 5_000_000_000))
+    d1 = bench._file_digest(str(fx))
+    # same size, same integer second (5), different nanoseconds
+    fx.write_bytes(b"fixture content B")
+    os.utime(fx, ns=(1_000_000_000, 5_000_000_500))
+    d2 = bench._file_digest(str(fx))
+    assert d1 != d2, ("same-second same-size regen served a stale "
+                      "content digest")
+    # identical stat -> cache hit (no rehash needed): digest stable
+    assert bench._file_digest(str(fx)) == d2

@@ -131,6 +131,20 @@ def test_config_fingerprint_distinguishes_sweep_rows(monkeypatch):
     monkeypatch.delenv("BENCH_PLATFORM")
     monkeypatch.setenv("BENCH_REMAT", "1")
     assert bench._config_fingerprint() != base
+    # byte-diet lever axes (ISSUE 5): different compiled programs, so
+    # lever rows must never cross-substitute — but the axes appear only
+    # when NON-default, so pre-existing banked records keep matching
+    # default asks (no orphaned history)
+    monkeypatch.delenv("BENCH_REMAT")
+    monkeypatch.setenv("BENCH_LOSS_CHUNK", "25")
+    chunked = bench._config_fingerprint()
+    assert chunked != base and chunked["loss_chunk"] == 25
+    monkeypatch.delenv("BENCH_LOSS_CHUNK")
+    monkeypatch.setenv("BENCH_OPT_DTYPE", "bfloat16")
+    opt = bench._config_fingerprint()
+    assert opt != base and opt["opt_dtype"] == "bfloat16"
+    monkeypatch.delenv("BENCH_OPT_DTYPE")
+    assert bench._config_fingerprint() == base
 
 
 def _write_jsonl(path, recs):
@@ -364,6 +378,50 @@ def test_supervisor_no_stale_on_deterministic_failure(tmp_path):
     # only ONE attempt despite BENCH_ATTEMPTS=2: deterministic failures
     # must not retry
     assert "attempt 1/2" in rec["error"]
+
+
+@pytest.mark.slow
+def test_bytes_mode_contract_on_cpu(tmp_path):
+    """BENCH_MODE=bytes end to end through the real supervisor+child at
+    tiny scale: one JSON line with the lever table, reduction fields,
+    and the analytic grad-allreduce bytes — the CPU-verifiable side of
+    the byte-diet claims (the committed REGRESSION gate lives in
+    tests/test_bytes_gate.py at the calibrated gate scale; this checks
+    the bench-row contract only, so no reduction thresholds here: at
+    tiny vocab the scores tensor is noise)."""
+    import json
+    import subprocess
+
+    path = tmp_path / "BENCH_ALL.jsonl"
+    env = dict(os.environ)
+    for var in ("TS_BENCH_CHILD", "BENCH_BATCH", "BENCH_PRESET",
+                "BENCH_FAMILY", "BENCH_LOSS_CHUNK", "BENCH_OPT_DTYPE",
+                "BENCH_NO_RECORD"):
+        env.pop(var, None)
+    env.update(BENCH_MODE="bytes", BENCH_PRESET="tiny", BENCH_BATCH="4",
+               BENCH_LOSS_CHUNK="2", BENCH_ATTEMPTS="1",
+               BENCH_TIMEOUT="300", BENCH_STALE_FILE=str(path),
+               BENCH_RUN_TAG="bytes_cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=360)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "train_step_bytes_accessed"
+    assert rec["value"] > 0
+    assert set(rec["levers"]) == {"baseline", "loss_chunk", "opt_bf16",
+                                  "combined"}
+    for lever in rec["levers"].values():
+        assert lever["bytes"] > 0 and lever["flops"] > 0
+    assert rec["levers"]["baseline"]["reduction_vs_baseline"] == 0.0
+    assert rec["grad_allreduce_bytes_bf16"] * 2 == \
+        rec["grad_allreduce_bytes_f32"]
+    assert rec["config_fingerprint"]["mode"] == "bytes"
+    assert rec["config_fingerprint"]["platform"] == "cpu"
+    assert rec["config_fingerprint"]["chunk"] == 2
+    lines = [json.loads(s) for s in path.read_text().strip().splitlines()]
+    assert len(lines) == 1 and lines[0] == rec
 
 
 def test_preset_overrides_family(monkeypatch):

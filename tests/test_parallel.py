@@ -116,6 +116,99 @@ def test_multi_step_training_loss_decreases(setup):
     assert losses[-1] < losses[0]
 
 
+class TestLowPrecisionGradAllReduce:
+    """--grad_allreduce_dtype=bfloat16 (ISSUE 5): the dp gradient psum
+    rides the wire in bf16 via the explicit shard_map step.  Parity is
+    pinned on the 2-process CPU collective test shape (global batch 8
+    over dp=4, tests/_multiproc_worker.py) against the single-device f32
+    step: the bf16 cast is the ONLY semantic difference, so losses match
+    exactly, the gradient norm to bf16 rounding, and N-step training
+    stays in a tight envelope."""
+
+    def _lowp_step(self, setup, dp):
+        hps, vocab, batch, state, *_ = setup
+        hps_m = hps.replace(dp=dp, grad_allreduce_dtype="bfloat16")
+        plan = mesh_lib.make_mesh(hps_m)
+        return (plan, mesh_lib.shard_train_state(plan, state),
+                mesh_lib.make_sharded_train_step(plan, donate=False))
+
+    @pytest.mark.parametrize("dp", [4, 8])
+    def test_single_step_parity(self, setup, dp):
+        hps, vocab, batch, state, ref_state, ref_metrics = setup
+        plan, sharded, step = self._lowp_step(setup, dp)
+        new_state, metrics = step(sharded, batch.as_arrays())
+        # forward math untouched: per-shard losses pmean to the exact
+        # global mean (pointer losses decompose; validated requirement)
+        np.testing.assert_allclose(float(metrics.loss),
+                                   float(ref_metrics.loss), rtol=1e-5)
+        # the global norm sees the bf16-rounded gradients (~0.4% rel)
+        np.testing.assert_allclose(float(metrics.global_norm),
+                                   float(ref_metrics.global_norm),
+                                   rtol=1e-2)
+        # params move by the rounded update: pin each leaf's update
+        # vector in L2 against the f32 reference update, with an atol
+        # floor for leaves whose per-example grads mostly cancel
+        for p0, r, g in zip(
+                jax.tree_util.tree_leaves(state.params),
+                jax.tree_util.tree_leaves(ref_state.params),
+                jax.tree_util.tree_leaves(jax.device_get(new_state.params))):
+            ur = np.asarray(r) - np.asarray(p0)
+            ul = np.asarray(g) - np.asarray(p0)
+            err = np.linalg.norm(ur - ul)
+            assert err <= 0.05 * np.linalg.norm(ur) + 1e-4, \
+                (err, np.linalg.norm(ur))
+
+    def test_n_step_envelope(self, setup):
+        """20 steps on dp=4: losses track the f32 single-device run and
+        parameters stay within a small L2 envelope (measured 1.8e-3
+        worst-leaf rel; bound 10x)."""
+        hps, vocab, batch, state, *_ = setup
+        plan, sharded, step = self._lowp_step(setup, 4)
+        single = jax.jit(trainer_lib.make_train_step(hps))
+        s_ref, s_lowp = state, sharded
+        for _ in range(20):
+            s_ref, m_ref = single(s_ref, batch.as_arrays())
+            s_lowp, m_lowp = step(s_lowp, batch.as_arrays())
+        np.testing.assert_allclose(float(m_lowp.loss), float(m_ref.loss),
+                                   rtol=1e-3)
+        for r, g in zip(jax.tree_util.tree_leaves(s_ref.params),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(s_lowp.params))):
+            r, g = np.asarray(r), np.asarray(g)
+            rel = np.linalg.norm(r - g) / (np.linalg.norm(r) + 1e-12)
+            assert rel < 2e-2, rel
+
+    def test_rejects_unsupported_meshes_and_losses(self, setup):
+        hps, *_ = setup
+        with pytest.raises(ValueError, match="pure-dp"):
+            mesh_lib.make_sharded_train_step(mesh_lib.make_mesh(
+                hps.replace(dp=4, tp=2, grad_allreduce_dtype="bfloat16")))
+        with pytest.raises(ValueError, match="pointer_gen"):
+            mesh_lib.make_sharded_train_step(mesh_lib.make_mesh(
+                hps.replace(dp=4, pointer_gen=False,
+                            grad_allreduce_dtype="bfloat16")))
+
+    def test_bf16_accumulator_composes_with_lowp_allreduce(self, setup):
+        """Both byte-diet state levers together on the mesh: bf16 psum +
+        bf16 Adagrad accumulator — runs, learns, keeps dtypes."""
+        hps, vocab, batch, state, *_ = setup
+        hps_m = hps.replace(dp=4, grad_allreduce_dtype="bfloat16",
+                            opt_state_dtype="bfloat16")
+        state16 = trainer_lib.init_train_state(hps_m, vocab.size(), seed=7)
+        plan = mesh_lib.make_mesh(hps_m)
+        sharded = mesh_lib.shard_train_state(plan, state16)
+        step = mesh_lib.make_sharded_train_step(plan, donate=False)
+        losses = []
+        for _ in range(5):
+            sharded, metrics = step(sharded, batch.as_arrays())
+            losses.append(float(metrics.loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        for leaf in jax.tree_util.tree_leaves(
+                sharded.opt_state.accumulators):
+            assert leaf.dtype == jnp.bfloat16
+
+
 def test_sharded_beam_search_matches_single_device(setup):
     """dp-sharded decode returns the same hypotheses as single-device."""
     from textsummarization_on_flink_tpu.decode import beam_search

@@ -34,6 +34,24 @@ def test_tiny_config_costs_are_consistent():
     assert rec["bound"] in ("bandwidth", "compute")
 
 
+def test_byte_diet_lever_configs_resolve():
+    """The lever rows (ISSUE 5) must resolve through the SAME env
+    mapping the sweep uses — hps_for is the single source, so the
+    roofline always describes exactly the config bench.py measures."""
+    bench_mod = roofline._load_bench()
+    assert roofline.hps_for("train_b16_losschunk", bench_mod).loss_chunk \
+        == 25
+    assert roofline.hps_for("train_b16_optbf16",
+                            bench_mod).opt_state_dtype == "bfloat16"
+    both = roofline.hps_for("train_b16_bytediet", bench_mod)
+    assert both.loss_chunk == 25 and both.opt_state_dtype == "bfloat16"
+    tfc = roofline.hps_for("train_transformer_losschunk", bench_mod)
+    assert tfc.model_family == "transformer" and tfc.loss_chunk == 25
+    # every lever row's declared baseline is itself a known config
+    for tag, base in roofline._BYTE_DIET_BASELINES.items():
+        assert tag in roofline.CONFIGS and base in roofline.CONFIGS
+
+
 def test_measured_join_uses_live_records_only(tmp_path):
     path = tmp_path / "BENCH_ALL.jsonl"
     rows = [

@@ -33,6 +33,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from textsummarization_on_flink_tpu import obs
@@ -74,7 +75,13 @@ def _flatten(tree: PyTree, prefix: str = "") -> Dict[str, np.ndarray]:
             for i, v in enumerate(node):
                 rec(v, f"{path}/{i}" if path else str(i))
         else:
-            out[path] = np.asarray(node)
+            arr = np.asarray(node)
+            if arr.dtype == jnp.bfloat16:
+                # npz silently degrades ml_dtypes bf16 to a raw void
+                # dtype on round trip; widen losslessly to f32 here and
+                # let trainer.cast_opt_state re-narrow on resume
+                arr = arr.astype(np.float32)
+            out[path] = arr
 
     rec(tree, prefix)
     return out

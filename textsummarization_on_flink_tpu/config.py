@@ -89,6 +89,26 @@ class HParams:
     # every host — Trainer hard-errors otherwise); 0 on single-host
     # keeps the wall-clock save_model_secs cadence
     checkpoint_steps: int = 0
+    # ---- byte diet (PERF.md "Byte diet"; ISSUE 5) ----
+    # Streaming chunked vocab loss: > 0 computes the training loss in
+    # lax.scan chunks of this many decoder steps, so only a
+    # [chunk, B, V] scores block ever exists (forward AND backward — a
+    # custom VJP recomputes each chunk's scores instead of holding the
+    # [T_dec, B, V] residual, ~2x320 MB at reference scale).  0 keeps
+    # the materialized hoisted-projection path.  Token-exact and
+    # grad-parity-pinned vs chunk=0 for both model families.
+    loss_chunk: int = 0
+    # Adagrad accumulator storage dtype: "bfloat16" halves the optimizer
+    # state's HBM footprint and read/write traffic; the update math
+    # still runs in f32 (accumulate -> rsqrt -> apply) and params stay
+    # f32 masters.  N-step drift vs f32 is pinned by test.
+    opt_state_dtype: str = "float32"
+    # dp-gradient all-reduce dtype: "bfloat16" halves the per-step
+    # gradient collective bytes (the psum is issued explicitly in
+    # parallel/mesh.py via shard_map; f32 everywhere else).  Requires a
+    # pure-dp mesh (tp=sp=1) and pointer_gen losses (whose per-example
+    # normalization makes shard-mean == global-mean exactly).
+    grad_allreduce_dtype: str = "float32"
     # rematerialize transformer layers in backward (jax.checkpoint):
     # trades ~1/3 more FLOPs for O(layers) less activation HBM — for the
     # long-context configs (enc 800+) where activations dominate
@@ -306,6 +326,31 @@ class HParams:
         if self.scan_unroll < 1:
             raise ValueError(
                 f"scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.loss_chunk < 0:
+            raise ValueError(
+                f"loss_chunk must be >= 0 (0 = materialized loss), got "
+                f"{self.loss_chunk}")
+        if self.opt_state_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"bad opt_state_dtype {self.opt_state_dtype!r} "
+                f"(float32/bfloat16)")
+        if self.grad_allreduce_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"bad grad_allreduce_dtype {self.grad_allreduce_dtype!r} "
+                f"(float32/bfloat16)")
+        if self.grad_allreduce_dtype == "bfloat16":
+            if self.tp > 1 or self.sp > 1:
+                raise ValueError(
+                    "grad_allreduce_dtype=bfloat16 issues the dp gradient "
+                    "psum explicitly via shard_map, which supports pure-dp "
+                    "meshes only (tp=sp=1); the tp/sp collectives inside "
+                    "forward stay on the pjit path")
+            if not self.pointer_gen:
+                raise ValueError(
+                    "grad_allreduce_dtype=bfloat16 requires pointer_gen "
+                    "losses: the baseline CE normalizes by the GLOBAL "
+                    "token count, which the per-shard objective cannot "
+                    "express (shard-mean != global mean)")
         if self.steps_per_dispatch < 1:
             raise ValueError(f"steps_per_dispatch must be >= 1, got "
                              f"{self.steps_per_dispatch}")

@@ -156,12 +156,10 @@ def _cast(hps: HParams, x: Array) -> Array:
 def _proj(hps: HParams, x: Array, w: Array) -> Array:
     """x @ w with bf16 operands + f32 accumulation in bfloat16 mode — the
     [H, vocab] output projection is the FLOP-dominant matmul (SURVEY §7.2
-    step 7 note); casting it to the MXU's native bf16 roughly doubles its
-    throughput while the f32 accumulator keeps softmax-grade precision."""
-    if hps.compute_dtype == "bfloat16":
-        return jnp.dot(x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
-                       preferred_element_type=jnp.float32)
-    return x @ w
+    step 7 note).  Delegates to the ONE dtype-aware vocab matmul
+    (ops/losses.project_scores) so the streaming chunked loss projects
+    identically."""
+    return loss_ops.project_scores(x, w, hps.compute_dtype)
 
 
 def encode(params: Params, hps: HParams, enc_batch: Array, enc_lens: Array,
@@ -267,23 +265,39 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
     # is also held as an autodiff residual (logsumexp/take_along_axis
     # grads need it), so training peak HBM grows by roughly 2x its size;
     # --remat recomputes it in backward instead (trade ~one extra
-    # projection matmul for the residual) for larger batches/vocabs.
+    # projection matmul for the residual) for larger batches/vocabs, and
+    # --loss_chunk streams the projection+loss in T_dec chunks so the
+    # full scores tensor never materializes in EITHER pass (the byte
+    # diet, PERF.md) — token-exact vs the materialized path.
     dec_mask = arrays["dec_padding_mask"]
     targets_t = jnp.swapaxes(arrays["target_batch"], 0, 1)  # [T_dec, B]
 
-    def scores_loss(outputs, attn_dists, p_gens):
-        scores = _proj(hps, outputs, w) + v  # [T_dec, B, V]
+    if hps.loss_chunk > 0:
         if hps.pointer_gen:
-            gold = loss_ops.gold_mixture_prob_from_scores(
-                scores, attn_dists, p_gens, targets_t,
-                arrays["enc_batch_extend_vocab"])
-            return loss_ops.pointer_nll(jnp.swapaxes(gold, 0, 1), dec_mask)
-        return loss_ops.softmax_cross_entropy_baseline(
-            jnp.swapaxes(scores, 0, 1), arrays["target_batch"], dec_mask)
+            gold = loss_ops.streaming_gold_probs(
+                outputs, attn_dists, p_gens, targets_t,
+                arrays["enc_batch_extend_vocab"], w, v,
+                chunk=hps.loss_chunk, compute_dtype=hps.compute_dtype)
+            loss = loss_ops.pointer_nll(jnp.swapaxes(gold, 0, 1), dec_mask)
+        else:
+            loss = loss_ops.streaming_softmax_cross_entropy(
+                outputs, targets_t, jnp.swapaxes(dec_mask, 0, 1), w, v,
+                chunk=hps.loss_chunk, compute_dtype=hps.compute_dtype)
+    else:
+        def scores_loss(outputs, attn_dists, p_gens):
+            scores = _proj(hps, outputs, w) + v  # [T_dec, B, V]
+            if hps.pointer_gen:
+                gold = loss_ops.gold_mixture_prob_from_scores(
+                    scores, attn_dists, p_gens, targets_t,
+                    arrays["enc_batch_extend_vocab"])
+                return loss_ops.pointer_nll(jnp.swapaxes(gold, 0, 1),
+                                            dec_mask)
+            return loss_ops.softmax_cross_entropy_baseline(
+                jnp.swapaxes(scores, 0, 1), arrays["target_batch"], dec_mask)
 
-    if hps.remat:
-        scores_loss = jax.checkpoint(scores_loss)
-    loss = scores_loss(outputs, attn_dists, p_gens)
+        if hps.remat:
+            scores_loss = jax.checkpoint(scores_loss)
+        loss = scores_loss(outputs, attn_dists, p_gens)
     attn_b = jnp.swapaxes(attn_dists, 0, 1)  # [B, T_dec, T_enc]
     if hps.coverage:
         cov_loss = loss_ops.coverage_loss(attn_b, dec_mask)

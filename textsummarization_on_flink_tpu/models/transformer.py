@@ -375,31 +375,48 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
         cross_ctx = c
     h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
 
-    logits = (pg._proj(hps, h, params["embedding"].T)
-              + params["out_bias"])  # [B, T_dec, V] tied projection
     p_gens = jax.nn.sigmoid(
         jnp.concatenate([h, cross_ctx.astype(jnp.float32)], axis=-1)
         @ params["pgen_linear"]["kernel"]
         + params["pgen_linear"]["bias"])[..., 0]  # [B, T_dec]
 
     targets = arrays["target_batch"]
-    V = logits.shape[-1]
-    if hps.pointer_gen:
-        # gold prob without materializing softmax over [B, T, V]
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        in_vocab = targets < V
-        safe_t = jnp.where(in_vocab, targets, 0)
-        gen_logp = jnp.take_along_axis(
-            logits, safe_t[..., None], axis=-1)[..., 0] - lse
-        gen_prob = jnp.where(in_vocab, jnp.exp(gen_logp), 0.0)
-        copy_prob = jnp.sum(
-            attn_dist * (arrays["enc_batch_extend_vocab"][:, None, :]
-                         == targets[..., None]), axis=-1)
-        gold = p_gens * gen_prob + (1.0 - p_gens) * copy_prob
-        loss = loss_ops.mask_and_avg(-jnp.log(gold + 1e-10), dec_mask)
+    if hps.loss_chunk > 0:
+        # streaming chunked loss (PERF.md byte diet): the [B, T_dec, V]
+        # tied-projection logits never materialize — ops/losses streams
+        # [chunk, B, V] blocks with a backward that recomputes them.
+        # Step-major views for the shared streaming kernels.
+        h_t = jnp.swapaxes(h, 0, 1)  # [T_dec, B, H]
+        targets_t = jnp.swapaxes(targets, 0, 1)
+        if hps.pointer_gen:
+            gold_t = loss_ops.streaming_gold_probs(
+                h_t, jnp.swapaxes(attn_dist, 0, 1),
+                jnp.swapaxes(p_gens, 0, 1), targets_t,
+                arrays["enc_batch_extend_vocab"],
+                params["embedding"].T, params["out_bias"],
+                chunk=hps.loss_chunk, compute_dtype=hps.compute_dtype)
+            gold = jnp.swapaxes(gold_t, 0, 1)
+            loss = loss_ops.mask_and_avg(-jnp.log(gold + 1e-10), dec_mask)
+        else:
+            loss = loss_ops.streaming_softmax_cross_entropy(
+                h_t, targets_t, jnp.swapaxes(dec_mask, 0, 1),
+                params["embedding"].T, params["out_bias"],
+                chunk=hps.loss_chunk, compute_dtype=hps.compute_dtype)
     else:
-        loss = loss_ops.softmax_cross_entropy_baseline(
-            logits, targets, dec_mask)
+        logits = (pg._proj(hps, h, params["embedding"].T)
+                  + params["out_bias"])  # [B, T_dec, V] tied projection
+        if hps.pointer_gen:
+            # gold prob without materializing the [B, T, V] softmax —
+            # the SAME mixture math as the pg family and the streaming
+            # path (one source of truth), on step-major views
+            gold = jnp.swapaxes(loss_ops.gold_mixture_prob_from_scores(
+                jnp.swapaxes(logits, 0, 1), jnp.swapaxes(attn_dist, 0, 1),
+                jnp.swapaxes(p_gens, 0, 1), jnp.swapaxes(targets, 0, 1),
+                arrays["enc_batch_extend_vocab"]), 0, 1)
+            loss = loss_ops.mask_and_avg(-jnp.log(gold + 1e-10), dec_mask)
+        else:
+            loss = loss_ops.softmax_cross_entropy_baseline(
+                logits, targets, dec_mask)
     if hps.coverage:
         cov_loss = loss_ops.coverage_loss(attn_dist, dec_mask)
     else:

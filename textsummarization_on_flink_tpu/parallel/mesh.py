@@ -212,12 +212,19 @@ def make_sharded_train_step(plan: MeshPlan, donate: bool = True,
     tp-axis collectives around the vocab matmuls, and the sp-axis context
     reductions.  This is the whole "distributed backend".
 
+    ``--grad_allreduce_dtype=bfloat16`` switches to an explicit-collective
+    variant (make_lowp_allreduce_train_step) where the dp gradient psum is
+    issued by hand in bf16 — half the per-step collective bytes.
+
     Pass `state` when its pytree structure differs from a fresh init (e.g.
     a TF1-imported non-coverage checkpoint has no decoder/attention/w_c
     leaf); specs are derived from the given tree so pjit's in_shardings
     structure matches.
     """
     hps = plan.hps
+    if getattr(hps, "grad_allreduce_dtype", "float32") == "bfloat16":
+        return make_lowp_allreduce_train_step(plan, donate=donate,
+                                              state=state)
     step_fn = _with_mesh_context(plan, trainer_lib.make_train_step(hps))
     probe = state if state is not None else jax.eval_shape(
         # structure only, nothing allocated
@@ -236,6 +243,80 @@ def make_sharded_train_step(plan: MeshPlan, donate: bool = True,
         out_shardings=(state_sh, metric_sh),
         donate_argnums=(0,) if donate else (),
     )
+
+
+def make_lowp_allreduce_train_step(
+        plan: MeshPlan, donate: bool = True,
+        state: Optional[trainer_lib.TrainState] = None):
+    """Data-parallel train step with the dp gradient all-reduce issued
+    EXPLICITLY in a low-precision dtype (--grad_allreduce_dtype=bfloat16).
+
+    The pjit path's gradient psum is inserted by XLA's partitioner in the
+    gradients' own dtype (f32) and cannot be narrowed from the outside,
+    so this variant runs the whole step under shard_map over the dp axis:
+    each shard computes grads on its local batch rows, the per-leaf psum
+    is cast to bf16 for the wire and widened back to f32 immediately
+    after (clipping/Adagrad/params all stay f32), and the optimizer
+    update replays identically on every shard.  Per-step collective bytes
+    halve — the roofline lever PERF.md's byte-diet section measures.
+
+    Restrictions (validated here and in HParams.validate):
+      * pure-dp mesh (tp=sp=1) — forward-internal tp/sp collectives stay
+        on the pjit path;
+      * pointer_gen losses — their per-example normalization makes the
+        mean-of-shard-means exactly the global mean, so the bf16 cast is
+        the ONLY difference from the pjit step (parity pinned by test).
+    """
+    import jax.numpy as jnp
+
+    hps = plan.hps
+    if plan.tp > 1 or plan.sp > 1:
+        raise ValueError(
+            "grad_allreduce_dtype=bfloat16 supports pure-dp meshes only "
+            f"(tp=sp=1), got tp={plan.tp} sp={plan.sp}")
+    if not hps.pointer_gen:
+        raise ValueError(
+            "grad_allreduce_dtype=bfloat16 requires pointer_gen losses "
+            "(shard-mean == global-mean); the baseline CE normalizes by "
+            "the global token count")
+    from textsummarization_on_flink_tpu.train import optim
+
+    loss_fn = trainer_lib.make_loss_fn(hps)
+    inv_dp = 1.0 / plan.dp
+
+    def per_shard(state, arrays):
+        grads, out = jax.grad(
+            lambda p: loss_fn(p, arrays), has_aux=True)(state.params)
+        # THE lever: the dp all-reduce rides the wire in bf16 (half the
+        # bytes); f32 is restored before any update math touches it
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g.astype(jnp.bfloat16), "dp")
+            .astype(jnp.float32) * inv_dp, grads)
+        grads, gnorm = optim.clip_by_global_norm(grads, hps.max_grad_norm)
+        new_params, new_opt = optim.adagrad_update(
+            grads, state.opt_state, state.params, hps.lr)
+        new_state = trainer_lib.TrainState(
+            params=new_params, opt_state=new_opt, step=state.step + 1)
+        metrics = trainer_lib.StepMetrics(
+            loss=jax.lax.pmean(out.loss, "dp"),
+            coverage_loss=jax.lax.pmean(out.coverage_loss, "dp"),
+            total_loss=jax.lax.pmean(out.total_loss, "dp"),
+            global_norm=gnorm)
+        return new_state, metrics
+
+    probe = state if state is not None else jax.eval_shape(
+        lambda: trainer_lib.init_train_state(hps, hps.vocab_size, seed=0))
+    state_specs = state_pspecs(probe)
+    batch_specs = {k: batch_pspec(k)
+                   for k in batch_sharding(plan)}
+    metric_specs = trainer_lib.StepMetrics(
+        loss=P(), coverage_loss=P(), total_loss=P(), global_norm=P())
+    from textsummarization_on_flink_tpu.parallel import ring_attention as ra
+
+    fn = ra.compat_shard_map(per_shard, plan.mesh,
+                             in_specs=(state_specs, batch_specs),
+                             out_specs=(state_specs, metric_specs))
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def make_sharded_eval_step(plan: MeshPlan, params: Optional[PyTree] = None):

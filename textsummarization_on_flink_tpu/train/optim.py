@@ -15,7 +15,7 @@ min(1, max_norm / global_norm).
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,16 +40,37 @@ def clip_by_global_norm(tree: PyTree, max_norm: float) -> Tuple[PyTree, Array]:
     return jax.tree_util.tree_map(lambda x: x * scale, tree), norm
 
 
-def adagrad_init(params: PyTree, initial_accumulator_value: float) -> AdagradState:
+def adagrad_init(params: PyTree, initial_accumulator_value: float,
+                 dtype: Optional[Any] = None) -> AdagradState:
+    """dtype=None stores the accumulator in each param's dtype (f32
+    masters -> f32 state, the TF1 behavior); dtype=jnp.bfloat16 halves
+    the optimizer state's HBM bytes (--opt_state_dtype=bfloat16) — the
+    update math still runs in f32, see adagrad_update."""
     return AdagradState(accumulators=jax.tree_util.tree_map(
-        lambda p: jnp.full_like(p, initial_accumulator_value), params))
+        lambda p: jnp.full(p.shape, initial_accumulator_value,
+                           dtype or p.dtype), params))
 
 
 def adagrad_update(grads: PyTree, state: AdagradState, params: PyTree,
                    lr: float) -> Tuple[PyTree, AdagradState]:
-    """Returns (new_params, new_state)."""
-    new_acc = jax.tree_util.tree_map(
-        lambda a, g: a + jnp.square(g), state.accumulators, grads)
+    """Returns (new_params, new_state).
+
+    Storage-dtype-aware: the accumulator is widened to the param dtype
+    (f32) before the g^2 add and the rsqrt, then rounded back to its
+    storage dtype — so a bf16 accumulator (--opt_state_dtype=bfloat16)
+    pays only HBM bytes, never f32 update precision within a step.  With
+    an f32 accumulator the widen/narrow casts are no-ops and the update
+    is bit-identical to the historical formula."""
+
+    def wide_acc(a, g, p):
+        return a.astype(p.dtype) + jnp.square(g)
+
+    new_acc32 = jax.tree_util.tree_map(wide_acc, state.accumulators, grads,
+                                       params)
     new_params = jax.tree_util.tree_map(
-        lambda p, g, a: p - lr * g * jax.lax.rsqrt(a), params, grads, new_acc)
+        lambda p, g, a: p - lr * g * jax.lax.rsqrt(a),
+        params, grads, new_acc32)
+    new_acc = jax.tree_util.tree_map(
+        lambda a32, a_old: a32.astype(a_old.dtype),
+        new_acc32, state.accumulators)
     return new_params, AdagradState(accumulators=new_acc)

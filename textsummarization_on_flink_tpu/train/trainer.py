@@ -53,28 +53,65 @@ class StepMetrics(NamedTuple):
     global_norm: Array
 
 
+def opt_state_dtype(hps: HParams):
+    """Adagrad accumulator storage dtype for this config (None = follow
+    the param dtype; jnp.bfloat16 under --opt_state_dtype=bfloat16)."""
+    if getattr(hps, "opt_state_dtype", "float32") == "bfloat16":
+        return jnp.bfloat16
+    return None
+
+
 def init_train_state(hps: HParams, vsize: int, seed: Optional[int] = None,
                      params: Optional[PyTree] = None) -> TrainState:
     if params is None:
         params = get_family(hps.model_family).init_params(
             hps, vsize, jax.random.PRNGKey(seed if seed is not None else hps.seed))
     return TrainState(params=params,
-                      opt_state=optim.adagrad_init(params, hps.adagrad_init_acc),
+                      opt_state=optim.adagrad_init(params,
+                                                   hps.adagrad_init_acc,
+                                                   dtype=opt_state_dtype(hps)),
                       step=jnp.zeros((), jnp.int32))
+
+
+def cast_opt_state(hps: HParams, state: TrainState) -> TrainState:
+    """Align a state's accumulator dtype with --opt_state_dtype (e.g. a
+    checkpoint restored as f32 — npz cannot hold bf16, so the
+    checkpointer widens on save — resuming a bf16-state run)."""
+    dtype = opt_state_dtype(hps) or jnp.float32
+    acc = state.opt_state.accumulators
+    leaves = jax.tree_util.tree_leaves(acc)
+    if all(getattr(x, "dtype", None) == dtype for x in leaves):
+        return state
+    return state._replace(opt_state=optim.AdagradState(
+        accumulators=jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a).astype(dtype), acc)))
+
+
+def make_loss_fn(hps: HParams):
+    """(params, arrays) -> (objective, TrainOutput) — the ONE definition
+    of the training objective, shared by make_train_step and the
+    explicit-collective sharded step (parallel/mesh.py) so the two can
+    never drift."""
+    family = get_family(hps.model_family)
+
+    def loss_fn(params: PyTree, arrays: Dict[str, Array]):
+        out = family.forward_train(params, hps, arrays)
+        # minimize total_loss when coverage is on (model.py:291)
+        objective = out.total_loss if hps.coverage else out.loss
+        return objective, out
+
+    return loss_fn
 
 
 def make_train_step(hps: HParams) -> Callable[[TrainState, Dict[str, Array]],
                                               Tuple[TrainState, StepMetrics]]:
     """Build the pure train-step function (jit it, or pjit via parallel/)."""
 
-    family = get_family(hps.model_family)
+    loss_fn_ = make_loss_fn(hps)
 
     def train_step(state: TrainState, arrays: Dict[str, Array]):
         def loss_fn(params):
-            out = family.forward_train(params, hps, arrays)
-            # minimize total_loss when coverage is on (model.py:291)
-            objective = out.total_loss if hps.coverage else out.loss
-            return objective, out
+            return loss_fn_(params, arrays)
 
         grads, out = jax.grad(loss_fn, has_aux=True)(state.params)
         grads, gnorm = optim.clip_by_global_norm(grads, hps.max_grad_norm)
@@ -454,6 +491,9 @@ class Trainer:
         self.checkpoint_steps = (checkpoint_steps
                                  or getattr(hps, "checkpoint_steps", 0))
         self.state = state if state is not None else init_train_state(hps, vsize)
+        # a restored checkpoint always holds f32 accumulators (npz cannot
+        # represent bf16); re-narrow when this run stores them in bf16
+        self.state = cast_opt_state(hps, self.state)
         # k train steps per device dispatch (an on-device scan over k
         # stacked batches — config.py steps_per_dispatch).  --debug pins
         # k=1: the exact per-step watchdog needs per-dispatch fetches.
