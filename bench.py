@@ -39,11 +39,16 @@ Modes (BENCH_MODE):
                     against the device's train samples/s).
   serve           — concurrent serving (SERVING.md): BENCH_SERVE_REQS
                     requests from BENCH_SERVE_CONCURRENCY submitter
-                    threads through ServingServer's admission queue +
-                    micro-batcher; p50/p99 END-TO-END latency (enqueue
-                    -> future resolved, queue wait included), mean
-                    batch fill, and requests/sec.  `python bench.py
-                    --serve` is shorthand for BENCH_MODE=serve.
+                    threads through ServingServer's admission queue;
+                    p50/p99 END-TO-END latency (enqueue -> future
+                    resolved, queue wait included), mean batch fill /
+                    slot occupancy, and requests/sec.  `python bench.py
+                    --serve` is shorthand for BENCH_MODE=serve;
+                    `--serve-mode=continuous|microbatch` picks the
+                    dispatch engine (BENCH_SERVE_MODE) and
+                    `--serve-mix=bimodal` the seeded short/long article
+                    mix (BENCH_SERVE_MIX) — the straggler workload the
+                    continuous engine exists for.
   bytes           — XLA cost-analysis byte accounting for the train
                     step (no execution; CPU-forced like input mode):
                     bytes accessed + intensity for the baseline config
@@ -1195,7 +1200,11 @@ def bench_serve() -> None:
     import jax
 
     from textsummarization_on_flink_tpu import obs
-    from textsummarization_on_flink_tpu.config import HParams
+    from textsummarization_on_flink_tpu.config import (
+        HParams,
+        resolve_refill_chunk,
+        resolve_serve_slots,
+    )
     from textsummarization_on_flink_tpu.data.vocab import Vocab
     from textsummarization_on_flink_tpu.decode.decoder import (
         BeamSearchDecoder,
@@ -1208,24 +1217,47 @@ def bench_serve() -> None:
     conc = int(os.environ.get("BENCH_SERVE_CONCURRENCY", "8"))
     batch = int(os.environ.get("BENCH_BATCH", "4"))
     wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "20"))
+    serve_mode = os.environ.get("BENCH_SERVE_MODE", "microbatch")
+    mix = os.environ.get("BENCH_SERVE_MIX", "buckets")
+    if mix not in ("buckets", "bimodal"):
+        # serve_mode is validated by hps.validate(); the mix needs its
+        # own guard or a typo silently runs the wrong workload under
+        # the requested label
+        raise ValueError(
+            f"BENCH_SERVE_MIX must be 'buckets' or 'bimodal', got {mix!r}")
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", "0"))
+    refill_chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "0"))
     hps = HParams(batch_size=batch, mode="decode", coverage=True,
-                  serve_max_wait_ms=wait_ms,
+                  serve_max_wait_ms=wait_ms, serve_mode=serve_mode,
+                  serve_slots=slots, serve_refill_chunk=refill_chunk,
                   serve_max_queue=max(256, reqs), **_preset_overrides())
+    hps.validate()
     if hps.model_family == "transformer":
         hps = hps.replace(coverage=False)
     rng = np.random.RandomState(0)
     n_words = max(hps.vocab_size - 4, 100)
     vocab = Vocab(words=[f"w{i}" for i in range(n_words)])
     pool = [f"w{i}" for i in range(min(n_words, 2000))]
-    # one article per bucket length plus a mixed request stream, so the
-    # warm phase compiles EVERY bucket and the timed phase exercises
-    # bucket routing instead of a single shape
     buckets = resolve_buckets(hps)
     articles = []
-    for i in range(32):
-        limit = buckets[i % len(buckets)]
-        n = rng.randint(max(limit // 2, 1), limit + 1)
-        articles.append(" ".join(rng.choice(pool, size=n)))
+    if mix == "bimodal":
+        # the straggler workload (SERVE_SLO.json shape): every 4th
+        # request a max-length article, the rest short — the load where
+        # the micro-batch dispatch barrier hurts and slot refill wins
+        short_n = max(4, hps.max_enc_steps // 8)
+        for i in range(32):
+            n = hps.max_enc_steps if i % 4 == 0 else \
+                rng.randint(max(short_n // 2, 1), short_n + 1)
+            articles.append(" ".join(rng.choice(pool, size=n)))
+        rng.shuffle(articles)
+    else:
+        # one article per bucket length plus a mixed request stream, so
+        # the warm phase compiles EVERY bucket and the timed phase
+        # exercises bucket routing instead of a single shape
+        for i in range(32):
+            limit = buckets[i % len(buckets)]
+            n = rng.randint(max(limit // 2, 1), limit + 1)
+            articles.append(" ".join(rng.choice(pool, size=n)))
     family = get_family(hps.model_family)
     params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(0))
     params = _stop_biased(params, hps.vocab_size,
@@ -1237,15 +1269,32 @@ def bench_serve() -> None:
         server = ServingServer(hps, vocab, decoder=decoder)
         reg = obs.registry()
         fill_h = reg.histogram("serve/batch_fill")
+        occ_h = reg.histogram("serve/slot_occupancy")
         with server:
-            for b in buckets:  # compile every bucket before timing
-                # exactly b words -> enc_len == b -> bucket_for picks
-                # bucket b itself (a shorter article would warm a
-                # SMALLER bucket and leave b's compile in the timed run)
-                words = [pool[i % len(pool)] for i in range(b)]
-                server.submit(" ".join(words),
-                              uuid=f"warm{b}").result(timeout=1200)
+            if serve_mode == "continuous":
+                # ONE resident shape: a single request warms all four
+                # slot kernels (init/pack/step/unpack)
+                server.submit(" ".join(pool[i % len(pool)]
+                                       for i in range(hps.max_enc_steps)),
+                              uuid="warm").result(timeout=1200)
+            else:
+                for b in buckets:  # compile every bucket before timing
+                    # exactly b words -> enc_len == b -> bucket_for
+                    # picks bucket b itself (a shorter article would
+                    # warm a SMALLER bucket and leave b's compile in
+                    # the timed run)
+                    words = [pool[i % len(pool)] for i in range(b)]
+                    server.submit(" ".join(words),
+                                  uuid=f"warm{b}").result(timeout=1200)
             fills0 = (fill_h.count, fill_h.sum)
+            occ0 = (occ_h.count, occ_h.sum)
+            # counters snapshot AFTER warm-up, like the histograms: the
+            # published row must carry the TIMED run only, on one
+            # measurement basis
+            refills0 = reg.counter("serve/slot_refills_total").value
+            evict0 = reg.counter("serve/deadline_evictions_total").value
+            shed0 = reg.counter("serve/shed_total").value
+            degraded0 = reg.counter("serve/degraded_total").value
             lat: list = []
 
             def one(i: int) -> None:
@@ -1258,8 +1307,22 @@ def bench_serve() -> None:
             with ThreadPoolExecutor(max_workers=conc) as ex:
                 list(ex.map(one, range(reqs)))
             wall = time.perf_counter() - t0
-        n_batches = max(fill_h.count - fills0[0], 1)
-        fill_mean = (fill_h.sum - fills0[1]) / n_batches
+        # continuous mode dispatches chunks, not micro-batches: report
+        # the batch stats as zero rather than clamping to a fabricated
+        # one-batch row
+        n_batches = fill_h.count - fills0[0]
+        fill_mean = ((fill_h.sum - fills0[1]) / n_batches) if n_batches \
+            else 0.0
+        n_chunks = occ_h.count - occ0[0]
+        if serve_mode == "continuous":
+            # mean fraction of slots doing useful work per chunk step
+            occupancy = ((occ_h.sum - occ0[1]) / n_chunks) if n_chunks \
+                else 0.0
+        else:
+            # micro-batch analogue: mean dispatch fill over the device
+            # batch shape (hides straggler waste — the honest
+            # per-step utilization comparison lives in SERVE_SLO.json)
+            occupancy = fill_mean / hps.batch_size
 
         def pct(xs, q):
             xs = sorted(xs)
@@ -1272,16 +1335,31 @@ def bench_serve() -> None:
             "unit": "ms",
             "vs_baseline": 0.0,  # the reference publishes no serving numbers
             "p99_ms": round(pct(lat, 0.99) * 1000, 2),
+            "serve_mode": serve_mode,
+            "mix": mix,
             "batch_fill_mean": round(fill_mean, 2),
+            "occupancy_mean": round(occupancy, 3),
             "batches": n_batches,
+            "chunks": n_chunks,
+            "slot_refills_total": int(
+                reg.counter("serve/slot_refills_total").value - refills0),
+            "deadline_evictions_total": int(
+                reg.counter("serve/deadline_evictions_total").value
+                - evict0),
             "requests_per_sec": round(reqs / wall, 2),
             "reqs": reqs,
             "concurrency": conc,
             "batch": batch,
+            # through the config resolvers, so the published record
+            # carries the slot count / chunk the engine ACTUALLY ran
+            # (serve_slots=0 / serve_refill_chunk=0 are sentinels)
+            "slots": resolve_serve_slots(hps),
+            "refill_chunk": resolve_refill_chunk(hps),
             "wait_ms": wait_ms,
             "buckets": buckets,
-            "shed_total": int(reg.counter("serve/shed_total").value),
-            "degraded_total": int(reg.counter("serve/degraded_total").value),
+            "shed_total": int(reg.counter("serve/shed_total").value - shed0),
+            "degraded_total": int(
+                reg.counter("serve/degraded_total").value - degraded0),
             "model_family": hps.model_family,
             "timing": "wall-clock per request, enqueue -> resolved future "
                       "(queue wait + coalescing window included)",
@@ -1505,6 +1583,16 @@ if __name__ == "__main__":
         # the supervisor's fingerprint AND the re-exec'd child (which
         # never sees argv) both agree on the mode
         os.environ["BENCH_MODE"] = "serve"
+    for arg in sys.argv[1:]:
+        # serve-mode sub-flags ride the same env hand-off (the child
+        # never sees argv): --serve-mode=continuous|microbatch,
+        # --serve-mix=bimodal|buckets
+        if arg.startswith("--serve-mode="):
+            os.environ["BENCH_MODE"] = "serve"
+            os.environ["BENCH_SERVE_MODE"] = arg.split("=", 1)[1]
+        elif arg.startswith("--serve-mix="):
+            os.environ["BENCH_MODE"] = "serve"
+            os.environ["BENCH_SERVE_MIX"] = arg.split("=", 1)[1]
     if os.environ.get("TS_BENCH_CHILD") == "1":
         child_main()
     else:

@@ -52,8 +52,10 @@ print('entry() traces ok')
 g.dryrun_multichip(8)"
 
 echo "== serve smoke (CollectionSource -> ServingServer -> CollectionSink)"
-# the concurrent serving path (SERVING.md) over the 8 synthetic rows:
-# queue admission, micro-batching, bucket padding, future fan-in
+# the concurrent serving path (SERVING.md) over the 8 synthetic rows,
+# BOTH dispatch engines: micro-batch (queue admission, coalescing,
+# bucket padding) and continuous (slot refill at chunk boundaries),
+# with row-for-row parity asserted between them
 python scripts/serve_smoke.py
 
 echo "== bench smokes (CPU, tiny): train / input / decode / serve"
@@ -65,6 +67,16 @@ for mode in train input decode serve; do
     BENCH_ATTEMPTS=1 BENCH_STALE_FILE="$T/all.jsonl" \
     python bench.py 2>/dev/null | tail -1
 done
+
+echo "== continuous-mode serve load smoke (bimodal mix)"
+# the ISSUE-6 engine under the straggler workload it exists for: slot
+# occupancy + refills reported alongside p50/p99 (SERVE_SLO.json holds
+# the enforced scheduling claim; this proves the real-model path runs)
+BENCH_MODE=serve BENCH_PLATFORM=cpu BENCH_PRESET=tiny \
+  BENCH_SERVE_MODE=continuous BENCH_SERVE_MIX=bimodal \
+  BENCH_SERVE_REQS=8 BENCH_SERVE_CONCURRENCY=4 BENCH_ATTEMPTS=1 \
+  BENCH_STALE_FILE="$T/all.jsonl" \
+  python bench.py 2>/dev/null | tail -1
 
 echo "== roofline (XLA cost-model floors, tiny config)"
 # no --bench join here: the CPU smoke records are keyed/configured

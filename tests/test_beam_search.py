@@ -186,6 +186,155 @@ def test_loop_kinds_match_while_loop(params, coverage, kind):
                                np.asarray(b.attn_dists), atol=1e-6)
 
 
+@pytest.mark.parametrize("chunk", [1, 3, 5, 13])
+def test_chunked_early_exit_parity_any_chunk(params, chunk):
+    """The chunked loop must stay token-exact with the early-exit while
+    loop for EVERY tail-chunk shape (ISSUE 6 satellite): chunk=1
+    (degenerate — every step a boundary), 3 and 5 (neither divides
+    max_dec_steps=8, so the final chunk overshoots the horizon and the
+    masked inner scan must no-op the tail), and 13 (> max_dec_steps —
+    one chunk covers the whole search).  The slot loop steps the same
+    masked chunk body, so this parity is what continuous serving's
+    refill boundaries rest on."""
+    arrays = make_arrays(HPS, seed=11)
+    a = beam_search.run_beam_search_jit(params, HPS, arrays, loop="while")
+    b = beam_search.run_beam_search_jit(params, HPS, arrays, loop="chunked",
+                                        chunk=chunk)
+    np.testing.assert_array_equal(np.asarray(a.tokens), np.asarray(b.tokens))
+    np.testing.assert_array_equal(np.asarray(a.length), np.asarray(b.length))
+    np.testing.assert_allclose(np.asarray(a.avg_log_prob),
+                               np.asarray(b.avg_log_prob), rtol=1e-6)
+
+
+def test_chunked_parity_when_no_beam_finishes(params):
+    """Tail-chunk parity in the no-early-exit regime: min_dec_steps
+    near the horizon forces every article through max_dec_steps, so the
+    final (partial) chunk runs right up against the masked boundary."""
+    hps = HPS.replace(min_dec_steps=HPS.max_dec_steps - 1)
+    arrays = make_arrays(hps, seed=4)
+    a = beam_search.run_beam_search_jit(params, hps, arrays, loop="while")
+    b = beam_search.run_beam_search_jit(params, hps, arrays, loop="chunked",
+                                        chunk=3)
+    np.testing.assert_array_equal(np.asarray(a.tokens), np.asarray(b.tokens))
+    np.testing.assert_array_equal(np.asarray(a.length), np.asarray(b.length))
+
+
+class TestSlotSearch:
+    """The continuous-batching slot kernels (pack/step/unpack over a
+    persistent [slots, beam, ...] state) against the batch search:
+    identical per-article trajectories, per-slot activity masking, and
+    a jit cache that never grows with slot index or occupancy."""
+
+    def _drive(self, params, hps, state, active, chunk, max_chunks=16):
+        """Step until every active slot finishes; returns {slot: output,
+        ...} plus the number of chunks run."""
+        done = {}
+        active = np.array(active)
+        for n in range(1, max_chunks + 1):
+            state, fin = beam_search.step_slots_jit(params, hps, state,
+                                                    active, chunk)
+            for s in np.nonzero(np.asarray(fin))[0]:
+                done[int(s)] = beam_search.unpack_slot_jit(hps, state, int(s))
+                active[s] = False
+            if not active.any():
+                return state, done, n
+        raise AssertionError("slots never finished")
+
+    def test_slot_parity_with_batch_search(self, params):
+        """Articles packed into arbitrary slots, stepped with a chunk
+        that does NOT divide max_dec_steps, finish token-exact with the
+        one-dispatch batch search."""
+        arrays = make_arrays(HPS, seed=0)
+        ref = beam_search.run_beam_search(params, HPS, arrays)
+        slots = 3
+        zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
+                for k, v in arrays.items()}
+        state = beam_search.init_slots_jit(params, HPS, zero)
+        placement = {2: 0, 0: 1}  # slot -> article
+        for slot, art in placement.items():
+            one = {k: v[art:art + 1] for k, v in arrays.items()}
+            state = beam_search.pack_slot_jit(params, HPS, state, slot, one)
+        _, done, _ = self._drive(params, HPS, state,
+                                 [True, False, True], chunk=3)
+        assert sorted(done) == sorted(placement)
+        for slot, art in placement.items():
+            out = done[slot]
+            n = int(out.length)
+            n_ref = int(ref.length[art])
+            assert n == n_ref
+            assert list(np.asarray(out.tokens)[:n]) == \
+                list(ref.tokens[art][:n_ref])
+            np.testing.assert_allclose(np.asarray(out.avg_log_prob),
+                                       ref.avg_log_prob[art], rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(out.attn_dists),
+                                       ref.attn_dists[art], atol=1e-6)
+
+    def test_inactive_slots_never_finish_and_refill_is_exact(self, params):
+        """An inactive slot's garbage state never reports finished, and
+        packing a NEW article into a just-retired slot reproduces that
+        article's batch-search result exactly — the refill contract the
+        continuous scheduler depends on."""
+        arrays = make_arrays(HPS, seed=9)
+        ref = beam_search.run_beam_search(params, HPS, arrays)
+        slots = 2
+        zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
+                for k, v in arrays.items()}
+        state = beam_search.init_slots_jit(params, HPS, zero)
+        one = {k: v[0:1] for k, v in arrays.items()}
+        state = beam_search.pack_slot_jit(params, HPS, state, 1, one)
+        state, fin = beam_search.step_slots_jit(
+            params, HPS, state, np.array([False, True]), 2)
+        assert not bool(np.asarray(fin)[0])  # inactive slot stays silent
+        # retire slot 1 whenever it finishes, then REFILL it with
+        # article 1 and check the second tenancy end to end
+        active = np.array([False, True])
+        done = {}
+        for _ in range(16):
+            for s in np.nonzero(np.asarray(fin))[0]:
+                done[int(s)] = beam_search.unpack_slot_jit(HPS, state, int(s))
+                active[s] = False
+            if done:
+                break
+            state, fin = beam_search.step_slots_jit(params, HPS, state,
+                                                    active, 2)
+        assert 1 in done
+        two = {k: v[1:2] for k, v in arrays.items()}
+        state = beam_search.pack_slot_jit(params, HPS, state, 1, two)
+        _, done2, _ = self._drive(params, HPS, state, [False, True], chunk=2)
+        out = done2[1]
+        n = int(out.length)
+        assert list(np.asarray(out.tokens)[:n]) == \
+            list(ref.tokens[1][:int(ref.length[1])])
+
+    def test_slot_kernels_compile_once(self, params):
+        """Slot index, occupancy pattern, and article content are all
+        traced — after the first pack/step/unpack, serving more articles
+        through different slots adds ZERO jit-cache entries (the
+        'no per-request recompiles' acceptance claim at kernel level)."""
+        arrays = make_arrays(HPS, seed=2)
+        slots = 3
+        zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
+                for k, v in arrays.items()}
+        state = beam_search.init_slots_jit(params, HPS, zero)
+        one = {k: v[0:1] for k, v in arrays.items()}
+        state = beam_search.pack_slot_jit(params, HPS, state, 0, one)
+        state, _ = beam_search.step_slots_jit(
+            params, HPS, state, np.array([True, False, False]), 3)
+        beam_search.unpack_slot_jit(HPS, state, 0)
+        sizes = {f: f._cache_size()
+                 for f in (beam_search.pack_slot_jit,
+                           beam_search.step_slots_jit,
+                           beam_search.unpack_slot_jit)}
+        for slot, art in ((1, 1), (2, 0), (0, 1)):
+            nxt = {k: v[art:art + 1] for k, v in arrays.items()}
+            state = beam_search.pack_slot_jit(params, HPS, state, slot, nxt)
+        state, _ = beam_search.step_slots_jit(
+            params, HPS, state, np.array([True, True, True]), 3)
+        beam_search.unpack_slot_jit(HPS, state, 2)
+        for f, before in sizes.items():
+            assert f._cache_size() == before, f
+
+
 def test_min_dec_steps_blocks_early_stop(params):
     # with min_dec_steps == max-1, any STOP before the horizon is discarded,
     # so results are either long or the live-beam fallback

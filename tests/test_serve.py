@@ -22,6 +22,9 @@ from textsummarization_on_flink_tpu.data.vocab import Vocab
 from textsummarization_on_flink_tpu.decode.decoder import DecodedResult
 from textsummarization_on_flink_tpu.obs import Registry
 from textsummarization_on_flink_tpu.pipeline import io as io_lib
+from textsummarization_on_flink_tpu.resilience.errors import (
+    DeadlineExceededError,
+)
 from textsummarization_on_flink_tpu.resilience.policy import (
     CircuitBreaker,
     Deadline,
@@ -63,6 +66,51 @@ def tiny_hps(**kw):
 def make_request(hps, vocab, uuid="u0", article="the cat sat .", **kw):
     ex = SummaryExample.build(article, [], vocab, hps, uuid=uuid)
     return ServeRequest(uuid, article, "", ex, **kw)
+
+
+class StubEngine:
+    """SlotDecodeEngine-protocol stub (jax-free): per-request decode
+    cost in CHUNKS derived from the example via `chunks_for`, optional
+    per-chunk delay — scheduling semantics without a device."""
+
+    def __init__(self, slots=2, chunk=2, chunks_for=None, delay=0.0):
+        self.slots = slots
+        self.chunk = chunk
+        self.delay = delay
+        self._chunks_for = chunks_for or (lambda ex: 1)
+        self._remaining = [0] * slots
+        self._active = [False] * slots
+        self.packs = 0
+        self.steps = 0
+
+    def pack(self, idx, example):
+        assert not self._active[idx], f"slot {idx} double-packed"
+        self._active[idx] = True
+        self._remaining[idx] = self._chunks_for(example)
+        self.packs += 1
+
+    def step(self):
+        if self.delay:
+            time.sleep(self.delay)
+        self.steps += 1
+        fin = []
+        for i in range(self.slots):
+            if self._active[i]:
+                self._remaining[i] -= 1
+                if self._remaining[i] <= 0:
+                    fin.append(i)
+        return fin
+
+    def unpack(self, idx, example):
+        assert self._active[idx]
+        self._active[idx] = False
+        return DecodedResult(
+            uuid=example.uuid, article=example.original_article,
+            decoded_words=["ok", "."], reference=example.reference,
+            abstract_sents=[])
+
+    def release(self, idx):
+        self._active[idx] = False
 
 
 class StubDecoder:
@@ -357,6 +405,29 @@ class TestServingServerStub:
         assert res.degraded
         assert _isolated_obs.counter("serve/degraded_total").value == 1
 
+    def test_expired_in_queue_evicted_typed_not_dispatched(
+            self, _isolated_obs):
+        """The ISSUE-6 eviction bugfix, micro-batch side: a request
+        whose enqueue-measured Deadline died while it waited in the
+        queue is resolved with the typed DeadlineExceededError at group
+        pickup (and counted) instead of burning dispatch time."""
+        hps, vocab = tiny_hps(serve_max_wait_ms=5.0,
+                              decode_deadline_secs=0.15), make_vocab()
+        server = ServingServer(hps, vocab, decoder=StubDecoder(delay=0.3),
+                               registry=_isolated_obs)
+        with server:
+            fresh = server.submit("the cat .", uuid="fresh")
+            time.sleep(0.05)  # let the first group dispatch alone
+            # ages out behind the 0.3s dispatch: 0.25s queued > 0.15s
+            stale = server.submit("the dog .", uuid="stale")
+            assert fresh.result(timeout=30).uuid == "fresh"
+            with pytest.raises(DeadlineExceededError, match="queued"):
+                stale.result(timeout=30)
+        assert _isolated_obs.counter(
+            "serve/deadline_evictions_total").value == 1
+        assert _isolated_obs.counter("serve/completed_total").value == 1
+        assert _isolated_obs.counter("serve/errors_total").value == 0
+
     def test_serve_drives_source_to_sink(self, _isolated_obs):
         hps, vocab = tiny_hps(), make_vocab()
         rows = [(f"uuid-{i}", f"the cat sat {i} .", "", f"ref {i}")
@@ -443,6 +514,120 @@ class TestServingServerStub:
             "pipeline/feeder_errors_total").value == 1
 
 
+# -- continuous batching (stub engine: scheduling semantics, no jax) -------
+
+def cont_hps(**kw):
+    base = dict(serve_mode="continuous", serve_slots=2, serve_refill_chunk=2)
+    base.update(kw)
+    return tiny_hps(**base)
+
+
+class TestContinuousServingStub:
+    def test_requests_resolve_with_own_uuid(self, _isolated_obs):
+        hps, vocab = cont_hps(), make_vocab()
+        engine = StubEngine(slots=2, chunks_for=lambda ex: 2)
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               engine=engine, registry=_isolated_obs)
+        with server:
+            futs = [server.submit("the cat sat .", uuid=f"u{i}")
+                    for i in range(10)]
+            results = [f.result(timeout=30) for f in futs]
+        assert [r.uuid for r in results] == [f"u{i}" for i in range(10)]
+        assert _isolated_obs.counter("serve/completed_total").value == 10
+        assert _isolated_obs.counter("serve/slot_refills_total").value == 10
+        # every request sat resident for exactly its 2 chunks
+        resident = _isolated_obs.histogram("serve/request_resident_chunks")
+        assert resident.count == 10 and resident.mean == 2.0
+        # occupancy was observed once per chunk step
+        assert _isolated_obs.histogram("serve/slot_occupancy").count > 0
+
+    def test_refill_beats_the_batch_barrier(self, _isolated_obs):
+        """The continuous claim at its smallest: one long request plus a
+        stream of short ones.  The shorts keep flowing through the OTHER
+        slot while the long one stays resident — so the long request
+        sees more refills happen around it than any fixed batch would
+        allow (a micro-batch would hold all of them hostage)."""
+        hps, vocab = cont_hps(), make_vocab()
+        engine = StubEngine(
+            slots=2,
+            chunks_for=lambda ex: 12 if "long" in ex.original_article else 1)
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               engine=engine, registry=_isolated_obs)
+        with server:
+            futs = [server.submit("a long long ride .", uuid="long")]
+            futs += [server.submit("the cat .", uuid=f"s{i}")
+                     for i in range(6)]
+            results = [f.result(timeout=30) for f in futs]
+        assert {r.uuid for r in results} == {"long"} | {
+            f"s{i}" for i in range(6)}
+        # the long request resolved LAST even though it was admitted
+        # first — neighbors never waited on it
+        resident = _isolated_obs.histogram("serve/request_resident_chunks")
+        assert resident.count == 7
+        assert _isolated_obs.counter("serve/slot_refills_total").value == 7
+
+    def test_dispatch_fault_fails_resident_only(self, _isolated_obs):
+        hps, vocab = cont_hps(
+            faults="serve.dispatch:1.0:0:1"), make_vocab()
+        engine = StubEngine(slots=2, chunks_for=lambda ex: 1)
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               engine=engine, registry=_isolated_obs)
+        # enqueue BEFORE start so both are resident when the fault fires
+        bad = [server.submit("the cat .", uuid=f"bad{i}") for i in range(2)]
+        with server:
+            for f in bad:
+                with pytest.raises(RuntimeError, match="injected"):
+                    f.result(timeout=30)
+            # the server survives at slot granularity: next request ok
+            ok = server.submit("the dog ran .", uuid="ok")
+            assert ok.result(timeout=30).uuid == "ok"
+        assert _isolated_obs.counter("serve/errors_total").value == 2
+        assert _isolated_obs.counter("serve/completed_total").value == 1
+
+    def test_deadline_evicts_queued_and_resident(self, _isolated_obs):
+        """The ISSUE-6 eviction bugfix, both sites: a resident request
+        whose budget runs out is evicted at a chunk boundary; a request
+        whose budget died while QUEUED is resolved typed at refill —
+        each with DeadlineExceededError, both counted."""
+        hps, vocab = cont_hps(serve_slots=1,
+                              decode_deadline_secs=0.1), make_vocab()
+        engine = StubEngine(
+            slots=1, delay=0.06,
+            chunks_for=lambda ex: 50 if "long" in ex.original_article else 1)
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               engine=engine, registry=_isolated_obs)
+        long_f = server.submit("a long long ride .", uuid="long")
+        short_f = server.submit("the cat .", uuid="short")
+        with server:
+            # the long request occupies the ONLY slot past its budget ->
+            # evicted resident; the short one ages out in the queue
+            # behind it -> evicted at refill
+            with pytest.raises(DeadlineExceededError, match="resident"):
+                long_f.result(timeout=30)
+            with pytest.raises(DeadlineExceededError, match="queued"):
+                short_f.result(timeout=30)
+            # a fresh request (fresh budget) still serves
+            ok = server.submit("the dog ran .", uuid="ok")
+            assert ok.result(timeout=30).uuid == "ok"
+        assert _isolated_obs.counter(
+            "serve/deadline_evictions_total").value == 2
+        assert _isolated_obs.counter("serve/completed_total").value == 1
+        # evictions are deadline OUTCOMES, not server errors
+        assert _isolated_obs.counter("serve/errors_total").value == 0
+
+    def test_stop_drains_admitted_requests(self, _isolated_obs):
+        hps, vocab = cont_hps(), make_vocab()
+        engine = StubEngine(slots=2, chunks_for=lambda ex: 2, delay=0.01)
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               engine=engine, registry=_isolated_obs)
+        server.start()
+        futs = [server.submit("the cat .", uuid=f"u{i}") for i in range(6)]
+        server.stop()  # drain-then-join: every admitted request resolves
+        assert all(f.done() for f in futs)
+        assert [f.result(0.1).uuid for f in futs] == \
+            [f"u{i}" for i in range(6)]
+
+
 # -- acceptance: >= 32 concurrent requests against a real tiny model -------
 
 class TestServingIntegration:
@@ -498,6 +683,55 @@ class TestServingIntegration:
         mean_fill = (fill.sum - 1) / n_batches  # minus the fill-1 warm
         assert mean_fill > 1.0
         assert reg.counter("serve/completed_total").value == 33
+
+    def test_continuous_mode_parity_and_bounded_jit_cache(
+            self, model_setup, tmp_path, _isolated_obs):
+        """Continuous acceptance against the REAL tiny model: (a) every
+        request resolves exactly once with its own uuid, (b) summaries
+        are token-identical to micro-batch mode on the same inputs (the
+        slot loop is the same masked chunk body — routing, not
+        semantics), (c) the slot-kernel jit cache does NOT grow after
+        warmup (no per-request recompiles), (d) occupancy/refill
+        telemetry is recorded."""
+        hps, vocab, params = model_setup
+        reg = _isolated_obs
+        articles = [
+            "the quick brown fox jumped over the lazy dog .",
+            "a big dog ran home .",
+            "the cat sat .",
+            "it was day and night and day .",
+        ]
+        hps_c = hps.replace(serve_mode="continuous", serve_slots=3,
+                            serve_refill_chunk=2)
+        server = ServingServer(hps_c, vocab, params=params,
+                               decode_root=str(tmp_path / "cont"),
+                               registry=reg)
+        with server:
+            server.submit(articles[0], uuid="warm").result(timeout=300)
+            engine = server._cont._engine
+            sizes_warm = engine.cache_sizes()
+            futs = [server.submit(articles[i % 4], uuid=f"u{i}")
+                    for i in range(12)]
+            results = [f.result(timeout=300) for f in futs]
+            sizes_after = engine.cache_sizes()
+        assert [r.uuid for r in results] == [f"u{i}" for i in range(12)]
+        # (c) bounded compile cache: slot index, occupancy, and article
+        # content are traced — 12 more requests, zero new executables
+        assert sizes_after == sizes_warm and sizes_warm
+        # (d) continuous telemetry
+        assert reg.counter("serve/slot_refills_total").value == 13
+        assert reg.histogram("serve/slot_occupancy").count > 0
+        assert reg.histogram("serve/request_resident_chunks").count == 13
+        # (b) mode parity: the same articles through micro-batch mode
+        server_mb = ServingServer(hps, vocab, params=params,
+                                  decode_root=str(tmp_path / "mb"),
+                                  registry=reg)
+        with server_mb:
+            futs_mb = [server_mb.submit(articles[i % 4], uuid=f"u{i}")
+                       for i in range(12)]
+            results_mb = [f.result(timeout=300) for f in futs_mb]
+        assert [r.summary for r in results] == \
+            [r.summary for r in results_mb]
 
     def test_small_queue_sheds_excess_but_serves_admitted(
             self, model_setup, tmp_path, _isolated_obs):

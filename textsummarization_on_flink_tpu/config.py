@@ -192,6 +192,25 @@ class HParams:
     # "" = auto: {max_enc_steps//4, //2, max_enc_steps}, dropping
     # sub-64 buckets (except max_enc_steps itself).
     serve_buckets: str = ""
+    # ---- continuous batching (SERVING.md "Continuous batching"; ISSUE 6) ----
+    # Serving dispatch engine: "microbatch" (the ISSUE-4 baseline and
+    # fallback — coalesce into fixed micro-batches, pay the
+    # dispatch-window barrier) or "continuous" (persistent slotted
+    # decode loop: finished sequences are masked out and their slots
+    # refilled from the queue at chunk boundaries, so one long article
+    # never holds neighbors hostage).
+    serve_mode: str = "microbatch"
+    # Resident decode slots for continuous mode (the [slots, beam, ...]
+    # persistent state's leading axis).  0 = batch_size.  More slots
+    # amortize the per-chunk dispatch over more articles but grow the
+    # resident state linearly.
+    serve_slots: int = 0
+    # Decode steps per continuous-mode chunk: finished slots are
+    # harvested and refilled every this-many steps.  Smaller = lower
+    # refill latency, more host round trips.  0 = the TS_BEAM_CHUNK
+    # default (beam_chunk_from_env, same source as the chunked beam
+    # loop), clamped to max_dec_steps.
+    serve_refill_chunk: int = 0
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score
@@ -378,6 +397,17 @@ class HParams:
         # parse for validation only — bad bucket specs fail at config
         # time, not at the first micro-batch
         parse_bucket_spec(self.serve_buckets, self.max_enc_steps)
+        if self.serve_mode not in ("microbatch", "continuous"):
+            raise ValueError(
+                f"serve_mode must be 'microbatch' or 'continuous', got "
+                f"{self.serve_mode!r}")
+        if self.serve_slots < 0:
+            raise ValueError(f"serve_slots must be >= 0 (0 = batch_size), "
+                             f"got {self.serve_slots}")
+        if self.serve_refill_chunk < 0:
+            raise ValueError(
+                f"serve_refill_chunk must be >= 0 (0 = TS_BEAM_CHUNK "
+                f"default), got {self.serve_refill_chunk}")
         if self.faults:
             # parse for validation only (unknown points / bad probs fail
             # here, at config time, not at the injection site)
@@ -442,6 +472,21 @@ def beam_chunk_from_env() -> int:
     import os
 
     return int(os.environ.get("TS_BEAM_CHUNK", "25"))
+
+
+def resolve_serve_slots(hps: "HParams") -> int:
+    """Effective continuous-mode slot count (serve_slots, or batch_size
+    when 0) — the ONE resolver, shared by serve/server.py and bench.py
+    so a measurement's slot count is exactly the server's."""
+    return hps.serve_slots or hps.batch_size
+
+
+def resolve_refill_chunk(hps: "HParams") -> int:
+    """Effective continuous-mode chunk length: serve_refill_chunk, or
+    the TS_BEAM_CHUNK default (the chunked beam loop's single source),
+    clamped to [1, max_dec_steps]."""
+    chunk = hps.serve_refill_chunk or beam_chunk_from_env()
+    return max(1, min(int(chunk), hps.max_dec_steps))
 
 
 def flash_mode_from_env() -> str:

@@ -137,28 +137,16 @@ class _BeamState(NamedTuple):
     res_pgen: Array  # [K+1, T]
 
 
-def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, chunk,
-                enc_one, enc_mask, ext_ids) -> BeamSearchOutput:
-    """Beam search for ONE article (un-batched inputs; vmapped below).
-
-    enc_one: the family's per-article encoder view (pytree, no batch
-    axis); enc_mask: [T_enc]; ext_ids: [T_enc] extended-vocab ids.
-    init_state_fn/step_fn: the family's beam adapter (models/__init__).
-    loop: 'while', 'scan', or 'chunked' (see _loop_kind); chunk: the
-    chunked inner-scan length, or None for the TS_BEAM_CHUNK env default
-    (read here, at trace time).
-    """
+def _init_beam_state(hps: HParams, T_enc: int, dec_state: Any) -> _BeamState:
+    """The step-0 search state for one article (dec_state comes from the
+    family's beam adapter; everything else is shape-only)."""
     K = hps.beam_size
     T = hps.max_dec_steps
-    T_enc = enc_mask.shape[0]
-    V = hps.vocab_size
-    S = K * 2 * K  # candidate count per step
-
-    init = _BeamState(
+    return _BeamState(
         t=jnp.zeros((), jnp.int32),
         tokens=jnp.full((K, T + 1), STOP_ID, jnp.int32).at[:, 0].set(START_ID),
         sum_lp=jnp.zeros((K,), jnp.float32),
-        dec_state=init_state_fn(params, enc_one),
+        dec_state=dec_state,
         attn_hist=jnp.zeros((K, T, T_enc), jnp.float32),
         pgen_hist=jnp.zeros((K, T), jnp.float32),
         n_res=jnp.zeros((), jnp.int32),
@@ -169,8 +157,26 @@ def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, chunk,
         res_pgen=jnp.zeros((K + 1, T), jnp.float32),
     )
 
+
+def _beam_cond(hps: HParams):
+    """The search-still-running predicate (reference's `steps <
+    max_dec_steps and len(results) < beam_size`, beam_search.py:118)."""
+
     def cond(s: _BeamState):
-        return jnp.logical_and(s.t < T, s.n_res < K)
+        return jnp.logical_and(s.t < hps.max_dec_steps,
+                               s.n_res < hps.beam_size)
+
+    return cond
+
+
+def _make_beam_body(params, hps: HParams, step_fn, enc_one, enc_mask,
+                    ext_ids):
+    """One decode step for one article, closed over its encoder view —
+    shared verbatim by the batch search (_search_one) and the slot loop
+    (step_slots_jit), so the two paths cannot drift."""
+    K = hps.beam_size
+    V = hps.vocab_size
+    S = K * 2 * K  # candidate count per step
 
     def body(s: _BeamState) -> _BeamState:
         latest = s.tokens[:, s.t]  # [K]
@@ -237,17 +243,43 @@ def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, chunk,
             res_pgen=res_pgen,
         )
 
-    # scan with masked updates: once cond(s) goes false the state is
-    # carried through unchanged, so the result is token-exact with
-    # the while_loop (whose vmapped form does the same masking).
-    # body's garbage reads at t == T (OOB gathers clamp, OOB scatter
-    # writes drop) are discarded by the select.
+    return body
+
+
+def _masked_scan_body(cond, body):
+    """Scan body with masked updates: once cond(s) goes false the state
+    is carried through unchanged, so the result is token-exact with the
+    while_loop (whose vmapped form does the same masking).  body's
+    garbage reads past the horizon (OOB gathers clamp, OOB scatter
+    writes drop) are discarded by the select."""
+
     def scan_body(s, _):
         s2 = body(s)
         keep = cond(s)
         s = jax.tree_util.tree_map(
             lambda old, new: jnp.where(keep, new, old), s, s2)
         return s, None
+
+    return scan_body
+
+
+def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, chunk,
+                enc_one, enc_mask, ext_ids) -> BeamSearchOutput:
+    """Beam search for ONE article (un-batched inputs; vmapped below).
+
+    enc_one: the family's per-article encoder view (pytree, no batch
+    axis); enc_mask: [T_enc]; ext_ids: [T_enc] extended-vocab ids.
+    init_state_fn/step_fn: the family's beam adapter (models/__init__).
+    loop: 'while', 'scan', or 'chunked' (see _loop_kind); chunk: the
+    chunked inner-scan length, or None for the TS_BEAM_CHUNK env default
+    (read here, at trace time).
+    """
+    T = hps.max_dec_steps
+    T_enc = enc_mask.shape[0]
+    init = _init_beam_state(hps, T_enc, init_state_fn(params, enc_one))
+    cond = _beam_cond(hps)
+    body = _make_beam_body(params, hps, step_fn, enc_one, enc_mask, ext_ids)
+    scan_body = _masked_scan_body(cond, body)
 
     if loop == "while":
         s = jax.lax.while_loop(cond, body, init)
@@ -271,6 +303,17 @@ def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, chunk,
     else:
         s, _ = jax.lax.scan(scan_body, init, None, length=T)
 
+    return _finalize_beam(hps, s, T_enc)
+
+
+def _finalize_beam(hps: HParams, s: _BeamState, T_enc: int,
+                   ) -> BeamSearchOutput:
+    """Rank the finished pool (falling back to the live beam) and emit
+    the best hypothesis — the reference's post-loop selection
+    (beam_search.py:158-168), shared by _search_one and unpack_slot_jit.
+    """
+    K = hps.beam_size
+    T = hps.max_dec_steps
     # results empty -> fall back to the live beam (beam_search.py:158-160)
     use_live = s.n_res == 0
     live_len = s.t + 1  # START + t generated tokens
@@ -323,6 +366,145 @@ def run_beam_search_jit(params, hps: HParams, arrays: Dict[str, Array],
                         loop: Optional[str] = None,
                         chunk: Optional[int] = None) -> BeamSearchOutput:
     return _search_batch(params, hps, arrays, loop, chunk)
+
+
+# --------------------------------------------------------------------------
+# Slot-state search: the continuous-batching kernel set (ISSUE 6)
+# --------------------------------------------------------------------------
+#
+# The batch search above is all-or-nothing: one dispatch decodes B
+# articles and returns when the SLOWEST finishes — the straggler barrier
+# FastSeq (PAPERS.md) removes.  The slot API splits that dispatch into
+# chunk-granular pieces over a persistent [slots, beam, ...] state so a
+# host scheduler (serve/batcher.ContinuousBatcher) can retire finished
+# articles and refill their slots between chunks:
+#
+#     state = init_slots_jit(params, hps, zero_arrays)     # once
+#     state = pack_slot_jit(params, hps, state, i, arrays1) # admit
+#     state, finished = step_slots_jit(params, hps, state, active, chunk)
+#     out = unpack_slot_jit(hps, state, i)                  # retire
+#
+# Contracts:
+#   * every kernel is shape-stable — slot index and active mask are
+#     TRACED arguments, so after the four warmup compiles NO request,
+#     slot choice, or occupancy pattern triggers a recompile;
+#   * per-slot activity masks: an inactive slot's state is carried
+#     through step_slots_jit unchanged (same masked-update select as the
+#     'chunked' batch loop, so a resident article's trajectory is
+#     token-exact with _search_one on the same inputs);
+#   * pack/unpack happen ONLY at chunk boundaries — the host never
+#     observes (or mutates) mid-chunk state.
+#
+# The per-article search itself is the SAME _make_beam_body /
+# _init_beam_state / _finalize_beam code the batch path runs; the slot
+# layer adds routing, not semantics.
+
+
+class SlotState(NamedTuple):
+    """Persistent decode state for `slots` resident articles.
+
+    beam leaves lead with [slots, ...] (each slot an independent
+    _BeamState); enc_view is the family's per-article encoder pytree
+    stacked over slots; enc_mask/ext_ids are [slots, T_enc].  All
+    shapes static: T_enc is fixed for the state's lifetime (continuous
+    serving pads every article to one length instead of bucketing —
+    one resident shape is what makes slot recycling shape-stable).
+    """
+
+    beam: Any  # _BeamState with [slots, ...] leaves
+    enc_view: Any  # family encoder view, [slots, ...] leaves
+    enc_mask: Array  # [slots, T_enc]
+    ext_ids: Array  # [slots, T_enc]
+
+
+def _init_slot_beams(params, hps: HParams, enc_view, enc_mask):
+    """vmapped step-0 beam state for a stack of articles."""
+    family = get_family(hps.model_family)
+    init_state_fn, _ = family.beam_adapter(hps)
+
+    def one(enc_one, mask):
+        return _init_beam_state(hps, mask.shape[0],
+                                init_state_fn(params, enc_one))
+
+    return jax.vmap(one)(enc_view, enc_mask)
+
+
+@functools.partial(jax.jit, static_argnames=("hps",))
+def init_slots_jit(params, hps: HParams,
+                   arrays: Dict[str, Array]) -> SlotState:
+    """The all-empty persistent state from a [slots, T_enc] arrays dict
+    (zeros are fine: inactive slots are never stepped unmasked and are
+    fully overwritten by pack_slot_jit before first use)."""
+    family = get_family(hps.model_family)
+    enc_view = family.beam_encode(params, hps, arrays)
+    return SlotState(
+        beam=_init_slot_beams(params, hps, enc_view,
+                              arrays["enc_padding_mask"]),
+        enc_view=enc_view,
+        enc_mask=arrays["enc_padding_mask"],
+        ext_ids=arrays["enc_batch_extend_vocab"])
+
+
+@functools.partial(jax.jit, static_argnames=("hps",))
+def pack_slot_jit(params, hps: HParams, state: SlotState, idx,
+                  arrays: Dict[str, Array]) -> SlotState:
+    """Admit ONE article (leading axis 1) into slot `idx`: encode it,
+    initialize its search, and scatter both into the persistent state.
+    `idx` is traced — one compile serves every slot."""
+    family = get_family(hps.model_family)
+    enc_view1 = family.beam_encode(params, hps, arrays)
+    beam1 = _init_slot_beams(params, hps, enc_view1,
+                             arrays["enc_padding_mask"])
+
+    def write(dst, src):
+        return dst.at[idx].set(src[0])
+
+    return SlotState(
+        beam=jax.tree_util.tree_map(write, state.beam, beam1),
+        enc_view=jax.tree_util.tree_map(write, state.enc_view, enc_view1),
+        enc_mask=state.enc_mask.at[idx].set(arrays["enc_padding_mask"][0]),
+        ext_ids=state.ext_ids.at[idx].set(
+            arrays["enc_batch_extend_vocab"][0]))
+
+
+@functools.partial(jax.jit, static_argnames=("hps", "chunk"))
+def step_slots_jit(params, hps: HParams, state: SlotState, active,
+                   chunk: int):
+    """Advance every ACTIVE slot by up to `chunk` masked decode steps.
+
+    active: [slots] bool.  Returns (state', finished) where finished[i]
+    marks an active slot whose search is done (horizon reached or beam
+    full of results) — the host retires it via unpack_slot_jit and may
+    refill.  Inactive slots run the same chunk on garbage state but
+    every update is discarded by the mask (the cost of shape stability;
+    a NaN in a dead lane never escapes the select)."""
+    family = get_family(hps.model_family)
+    _, step_fn = family.beam_adapter(hps)
+    cond = _beam_cond(hps)
+
+    def one(beam, act, enc_one, mask, ext):
+        body = _make_beam_body(params, hps, step_fn, enc_one, mask, ext)
+
+        def masked_cond(s):
+            return jnp.logical_and(act, cond(s))
+
+        scan_body = _masked_scan_body(masked_cond, body)
+        s, _ = jax.lax.scan(scan_body, beam, None, length=chunk)
+        return s, jnp.logical_and(act, jnp.logical_not(cond(s)))
+
+    beam, finished = jax.vmap(one)(state.beam, active, state.enc_view,
+                                   state.enc_mask, state.ext_ids)
+    return state._replace(beam=beam), finished
+
+
+@functools.partial(jax.jit, static_argnames=("hps",))
+def unpack_slot_jit(hps: HParams, state: SlotState, idx) -> BeamSearchOutput:
+    """The finished hypothesis for slot `idx` (no batch axis), ranked
+    exactly like the batch path's tail.  `idx` is traced — one compile.
+    The slot is NOT cleared here; the host's activity mask retires it
+    and the next pack overwrites the state."""
+    s = jax.tree_util.tree_map(lambda x: x[idx], state.beam)
+    return _finalize_beam(hps, s, state.enc_mask.shape[1])
 
 
 def resolved_chunk(loop: str) -> Optional[int]:

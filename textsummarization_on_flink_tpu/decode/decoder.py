@@ -330,25 +330,45 @@ class BeamSearchDecoder:
         for b in range(len(batch.original_articles)):
             if not batch.real_mask[b]:
                 continue
-            n = int(out.length[b])
-            output_ids = [int(t) for t in out.tokens[b][1:n]]  # strip START
-            decoded_words = oov_lib.outputids2words(
-                output_ids, self._vocab, batch.art_oovs[b])
-            # strip [STOP] if present (decode.py:112-118)
-            try:
-                fst_stop_idx = decoded_words.index(STOP_DECODING)
-                decoded_words = decoded_words[:fst_stop_idx]
-            except ValueError:
-                pass
-            results.append(DecodedResult(
-                uuid=batch.uuids[b],
+            results.append(self._make_result(
+                out.tokens[b], int(out.length[b]), out.attn_dists[b],
+                out.p_gens[b], uuid=batch.uuids[b],
                 article=batch.original_articles[b],
-                decoded_words=decoded_words,
                 reference=batch.references[b],
                 abstract_sents=batch.original_abstracts_sents[b],
-                attn_dists=out.attn_dists[b, : max(len(decoded_words), 1)],
-                p_gens=out.p_gens[b, : max(len(decoded_words), 1)]))
+                art_oovs=batch.art_oovs[b]))
         return results
+
+    def _make_result(self, tokens, length: int, attn_dists, p_gens, *,
+                     uuid: str, article: str, reference: str,
+                     abstract_sents: List[str],
+                     art_oovs: List[str]) -> DecodedResult:
+        """One article's raw beam output -> DecodedResult: START strip,
+        id->word mapping through the article's OOVs, [STOP] truncation
+        (decode.py:112-118).  Shared by the batch path and the slot
+        engine so the two serving modes emit identical rows."""
+        output_ids = [int(t) for t in tokens[1:length]]  # strip START
+        decoded_words = oov_lib.outputids2words(
+            output_ids, self._vocab, art_oovs)
+        try:
+            fst_stop_idx = decoded_words.index(STOP_DECODING)
+            decoded_words = decoded_words[:fst_stop_idx]
+        except ValueError:
+            pass
+        return DecodedResult(
+            uuid=uuid,
+            article=article,
+            decoded_words=decoded_words,
+            reference=reference,
+            abstract_sents=abstract_sents,
+            attn_dists=attn_dists[: max(len(decoded_words), 1)],
+            p_gens=p_gens[: max(len(decoded_words), 1)])
+
+    def slot_engine(self, slots: int, chunk: int) -> "SlotDecodeEngine":
+        """The continuous-batching engine over this decoder's params
+        (SERVING.md 'Continuous batching'): `slots` resident articles
+        decoded in `chunk`-step pieces with in-flight refill."""
+        return SlotDecodeEngine(self, slots, chunk)
 
     def decode(self, with_rouge: bool = True,
                result_sink: Optional[Callable[[DecodedResult], None]] = None,
@@ -440,3 +460,143 @@ class BeamSearchDecoder:
         with open(output_fname, "w", encoding="utf-8") as f:
             json.dump(to_write, f)
         log.info("Wrote visualization data to %s", output_fname)
+
+
+class SlotDecodeEngine:
+    """Host driver of beam_search's persistent slot kernels (ISSUE 6).
+
+    Owns the [slots, beam, ...] resident state and the per-slot activity
+    mask; the scheduler above it (serve/batcher.ContinuousBatcher) owns
+    request bookkeeping.  Single-threaded by design — the one
+    continuous-dispatch thread calls pack/step/unpack; the ONLY chunk
+    boundary host sync is reading the `finished` mask in step().
+
+    Shape discipline: every article is padded to ``hps.max_enc_steps``
+    (continuous mode trades the micro-batcher's length buckets for ONE
+    resident shape — that is what makes slot recycling shape-stable),
+    so the whole engine warms exactly four compiles (init/pack/step/
+    unpack); slot index and occupancy are traced arguments.  Compile
+    activity stays visible in the existing
+    ``decode/compile_cache_*_total`` counters.
+
+    Checkpoint hot-swap: each kernel call reads the decoder's
+    ``_params_snapshot()``, so a between-batch reload lands at the NEXT
+    chunk boundary — resident articles finish under the new params
+    (documented in SERVING.md; same shapes, so no recompile).
+    """
+
+    def __init__(self, decoder: BeamSearchDecoder, slots: int, chunk: int):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if chunk < 1:
+            raise ValueError(f"refill chunk must be >= 1, got {chunk}")
+        self._dec = decoder
+        self._hps = decoder._hps
+        self.slots = slots
+        self.chunk = min(chunk, self._hps.max_dec_steps)
+        self._t_enc = self._hps.max_enc_steps
+        self._hps1 = self._hps.replace(batch_size=1)
+        self._state = None  # lazy: first pack pays the init compile
+        self._active = np.zeros(slots, dtype=bool)
+        self._obs = obs.registry_for(self._hps)
+
+    def _jitted(self, fn, *args, **kw):
+        """Run a slot kernel, mirroring run_beam_search's compile-cache
+        telemetry so 'no per-request recompiles' is observable."""
+        try:
+            before = fn._cache_size()
+        except Exception:  # tslint: disable=TS005 — _cache_size is a private jax API; telemetry must never break decode
+            before = None
+        out = fn(*args, **kw)
+        if before is not None:
+            try:
+                missed = fn._cache_size() > before
+                self._obs.counter(
+                    "decode/compile_cache_misses_total" if missed
+                    else "decode/compile_cache_hits_total").inc()
+            except Exception:  # tslint: disable=TS005 — best-effort cache telemetry; result already in hand
+                pass
+        return out
+
+    def _ensure_state(self, params) -> None:
+        if self._state is not None:
+            return
+        zero = {
+            "enc_batch": np.zeros((self.slots, self._t_enc), np.int32),
+            "enc_lens": np.zeros((self.slots,), np.int32),
+            "enc_padding_mask": np.zeros((self.slots, self._t_enc),
+                                         np.float32),
+            "enc_batch_extend_vocab": np.zeros((self.slots, self._t_enc),
+                                               np.int32),
+        }
+        self._state = self._jitted(beam_search.init_slots_jit, params,
+                                   self._hps, zero)
+
+    def pack(self, idx: int, example) -> None:
+        """Admit one SummaryExample into slot `idx` (must be free)."""
+        if self._active[idx]:
+            raise AssertionError(f"slot {idx} is already resident")
+        params, _ = self._dec._params_snapshot()
+        self._ensure_state(params)
+        batch = Batch([example], self._hps1, self._dec._vocab,
+                      enc_steps=self._t_enc)
+        arrays = {k: v for k, v in batch.as_arrays().items()
+                  if k.startswith("enc_")}
+        self._state = self._jitted(beam_search.pack_slot_jit, params,
+                                   self._hps, self._state, idx, arrays)
+        self._active[idx] = True
+
+    def step(self) -> List[int]:
+        """One chunk for every resident slot; returns the slot indices
+        whose search finished (ready to unpack)."""
+        if not self._active.any():
+            return []
+        params, _ = self._dec._params_snapshot()
+        self._state, finished = self._jitted(
+            beam_search.step_slots_jit, params, self._hps, self._state,
+            self._active, self.chunk)
+        # the one sanctioned chunk-boundary sync: the host scheduler
+        # needs the finished mask to retire and refill slots
+        return [int(i) for i in np.nonzero(np.asarray(finished))[0]]
+
+    def unpack(self, idx: int, example) -> DecodedResult:
+        """Retire slot `idx`: finalize its hypothesis and free the slot.
+        `example` is the SummaryExample packed into it (uuid/reference/
+        OOV map travel with the request, not the device state)."""
+        if not self._active[idx]:
+            raise AssertionError(f"slot {idx} is not resident")
+        out = self._jitted(beam_search.unpack_slot_jit, self._hps,
+                           self._state, idx)
+        self._active[idx] = False
+        res = self._dec._make_result(
+            np.asarray(out.tokens), int(out.length),
+            np.asarray(out.attn_dists), np.asarray(out.p_gens),
+            uuid=example.uuid, article=example.original_article,
+            reference=example.reference,
+            abstract_sents=example.original_abstract_sents,
+            art_oovs=example.article_oovs)
+        self._dec._c_requests.inc()
+        self._dec._c_beams.inc()
+        self._dec._c_tokens.inc(len(res.decoded_words))
+        return res
+
+    def release(self, idx: int) -> None:
+        """Free slot `idx` WITHOUT unpacking (deadline eviction): the
+        stale state is masked out until the next pack overwrites it."""
+        self._active[idx] = False
+
+    def active_count(self) -> int:
+        return int(self._active.sum())
+
+    def cache_sizes(self) -> Dict[str, int]:
+        """Jit-cache entry counts of the four slot kernels — the
+        'bounded compile cache' evidence (tests assert no growth after
+        warmup)."""
+        out: Dict[str, int] = {}
+        for fn in (beam_search.init_slots_jit, beam_search.pack_slot_jit,
+                   beam_search.step_slots_jit, beam_search.unpack_slot_jit):
+            try:
+                out[fn.__wrapped__.__name__] = fn._cache_size()
+            except Exception:  # tslint: disable=TS005 — private jax API; absent on some builds
+                pass
+        return out

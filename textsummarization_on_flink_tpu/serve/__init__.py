@@ -16,7 +16,10 @@ Layer map:
     (bounded depth + admission circuit breaker; jax-free).
   * ``batcher`` — ``MicroBatcher`` + ``resolve_buckets`` (coalescing
     window ``serve_max_wait_ms``, size cap ``serve_max_batch``,
-    encoder-length buckets ``serve_buckets``; jax-free).
+    encoder-length buckets ``serve_buckets``; jax-free) and
+    ``ContinuousBatcher`` (``serve_mode=continuous``: persistent slotted
+    decode with in-flight refill at chunk boundaries — no
+    dispatch-window barrier; jax-free, engine injected).
   * ``server``  — ``ServingServer``: submit()/serve() fronting the
     decoder, deadline-from-enqueue degradation, between-batch
     checkpoint hot-swap, full obs instrumentation.
@@ -39,14 +42,15 @@ from textsummarization_on_flink_tpu.serve.queue import (
     ServeRequest,
 )
 from textsummarization_on_flink_tpu.serve.batcher import (
+    ContinuousBatcher,
     MicroBatcher,
     resolve_buckets,
 )
 
 __all__ = [
-    "MicroBatcher", "RequestQueue", "ServeClosedError", "ServeError",
-    "ServeFuture", "ServeOverloadError", "ServeRequest", "ServingServer",
-    "resolve_buckets",
+    "ContinuousBatcher", "MicroBatcher", "RequestQueue", "ServeClosedError",
+    "ServeError", "ServeFuture", "ServeOverloadError", "ServeRequest",
+    "ServingServer", "resolve_buckets",
 ]
 
 

@@ -11,21 +11,41 @@ shared service:
         fut = server.submit("some article text .", uuid="u1")
         result = fut.result(timeout=30)                 # DecodedResult
 
-Many callers submit concurrently; ONE dispatch thread pulls
-micro-batches (serve/batcher.py) off the admission-controlled queue
-(serve/queue.py) and runs them through ``BeamSearchDecoder.decode_batch``
-— so independent requests share device dispatches (batch-fill > 1 under
-load) while the jit cache stays bounded by the shape buckets.
+Many callers submit concurrently; ONE dispatch thread consumes the
+admission-controlled queue (serve/queue.py) through the engine
+``hps.serve_mode`` selects:
 
-Contracts:
+  * ``microbatch`` (default/fallback) — coalesce into micro-batches
+    (serve/batcher.MicroBatcher) and run each through
+    ``BeamSearchDecoder.decode_batch``: independent requests share
+    device dispatches (batch-fill > 1 under load), jit cache bounded by
+    the shape buckets;
+  * ``continuous`` — a persistent slotted decode loop
+    (serve/batcher.ContinuousBatcher over decode/decoder.
+    SlotDecodeEngine): free slots refill straight off the queue at
+    chunk boundaries, each future resolves the moment ITS sequence
+    finishes — no dispatch-window straggler barrier (SERVING.md
+    "Continuous batching").
+
+Contracts (both modes):
   * every admitted request resolves EXACTLY ONCE — with a
-    ``DecodedResult`` or with the typed error that killed its batch;
-  * per-request ``Deadline`` measured from enqueue: a batch dispatches
-    under the TIGHTEST deadline of its members, reusing the decoder's
-    beam->greedy degradation ladder (``_should_degrade``), degraded
-    results tagged and counted;
-  * checkpoint hot-swap happens BETWEEN batches via the decoder's
-    lock-guarded ``maybe_reload_checkpoint`` (never mid-dispatch);
+    ``DecodedResult`` or with the typed error that killed its batch
+    (microbatch) / its residency (continuous);
+  * per-request ``Deadline`` measured from enqueue, and a request whose
+    budget died waiting in the queue is evicted with the typed
+    ``DeadlineExceededError`` (counted in
+    ``serve/deadline_evictions_total``) instead of burning a dispatch.
+    Beyond that the modes differ: a micro-batch dispatches under the
+    TIGHTEST deadline of its members, reusing the decoder's
+    beam->greedy degradation ladder (``_should_degrade``, degraded
+    results tagged and counted); continuous mode never degrades (the
+    slot state is fixed-beam) — an expired RESIDENT is evicted typed at
+    the next chunk boundary;
+  * checkpoint hot-swap happens BETWEEN dispatches via the decoder's
+    lock-guarded ``maybe_reload_checkpoint`` — between batches
+    (microbatch) or ticks (continuous, where new params land at the
+    next chunk boundary, so a resident article may finish under
+    refreshed weights);
   * ``serve(source, sink)`` drives any pipeline/io.py Source/Sink pair
     through the queue with blocking-submit backpressure — the
     concurrency upgrade for ``pipeline/app.py:start_inference``.
@@ -44,7 +64,11 @@ import time
 from typing import Any, List, Optional, Sequence
 
 from textsummarization_on_flink_tpu import obs
-from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.config import (
+    HParams,
+    resolve_refill_chunk,
+    resolve_serve_slots,
+)
 from textsummarization_on_flink_tpu.data.batching import SummaryExample
 from textsummarization_on_flink_tpu.data.vocab import Vocab
 from textsummarization_on_flink_tpu.pipeline.io import (
@@ -54,8 +78,14 @@ from textsummarization_on_flink_tpu.pipeline.io import (
     Source,
 )
 from textsummarization_on_flink_tpu.resilience import faultinject
+from textsummarization_on_flink_tpu.resilience.errors import (
+    DeadlineExceededError,
+)
 from textsummarization_on_flink_tpu.resilience.policy import Deadline
-from textsummarization_on_flink_tpu.serve.batcher import MicroBatcher
+from textsummarization_on_flink_tpu.serve.batcher import (
+    ContinuousBatcher,
+    MicroBatcher,
+)
 from textsummarization_on_flink_tpu.serve.errors import (
     ServeClosedError,
     ServeOverloadError,
@@ -88,6 +118,7 @@ class ServingServer:
                  train_dir: Optional[str] = None,
                  decoder: Optional[Any] = None,
                  decode_root: Optional[str] = None,
+                 engine: Optional[Any] = None,
                  registry: Optional[obs.Registry] = None):
         self._hps = hps
         self._vocab = vocab
@@ -104,9 +135,23 @@ class ServingServer:
                 params=params, train_dir=train_dir, decode_root=decode_root)
         self._decoder = decoder
         self._queue = RequestQueue(hps.serve_max_queue, registry=self._reg)
-        self._batcher = MicroBatcher(hps, vocab, self._queue,
-                                     registry=self._reg)
         self._faults = faultinject.plan_for(hps)
+        self._mode = getattr(hps, "serve_mode", "microbatch")
+        self._batcher: Optional[MicroBatcher] = None
+        self._cont: Optional[ContinuousBatcher] = None
+        if self._mode == "continuous":
+            # engine= injects a stub (tests, SLO gate); the real one
+            # drives the decoder's persistent slot kernels
+            if engine is None:
+                engine = self._decoder.slot_engine(
+                    slots=resolve_serve_slots(hps),
+                    chunk=resolve_refill_chunk(hps))
+            self._cont = ContinuousBatcher(hps, self._queue, engine,
+                                           registry=self._reg,
+                                           faults=self._faults)
+        else:
+            self._batcher = MicroBatcher(hps, vocab, self._queue,
+                                         registry=self._reg)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._h_queue_time = self._reg.histogram(
@@ -116,6 +161,8 @@ class ServingServer:
         self._c_degraded = self._reg.counter("serve/degraded_total")
         self._c_errors = self._reg.counter("serve/errors_total")
         self._c_rows_out = self._reg.counter("serve/sink_rows_total")
+        self._c_evictions = self._reg.counter(
+            "serve/deadline_evictions_total")
 
     # -- lifecycle --
     def start(self) -> "ServingServer":
@@ -250,6 +297,9 @@ class ServingServer:
         return min(bounded, key=lambda d: d.remaining())
 
     def _run(self) -> None:
+        if self._mode == "continuous":
+            self._run_continuous()
+            return
         t_last = time.monotonic()
         while True:
             group = self._batcher.next_group()
@@ -275,10 +325,53 @@ class ServingServer:
                               "continuing on current params")
                 t_last = time.monotonic()
 
+    def _run_continuous(self) -> None:
+        """The continuous-mode dispatch loop: drive the ContinuousBatcher
+        scheduler (evict -> refill -> chunk step -> harvest) until
+        stopped AND drained.  A failed tick — injected serve.dispatch
+        fault, engine error — fails the RESIDENT requests only (each
+        resolves exactly once with the typed cause) and the loop lives
+        on, mirroring the micro-batch 'a failed dispatch fails its batch
+        only' contract at slot granularity."""
+        t_last = time.monotonic()
+        while True:
+            try:
+                self._cont.tick()
+            except Exception as e:  # tslint: disable=TS005 — every resident future is rejected with the typed cause and counted in serve/errors_total by fail_resident; the loop must outlive any one tick
+                n = self._cont.fail_resident(e)
+                log.exception("continuous dispatch tick failed; rejected "
+                              "%d resident request(s)", n)
+            if (self._stop.is_set() and self._queue.empty()
+                    and not self._cont.busy()):
+                return
+            try:
+                # same hot-swap cadence as the micro-batch loop (the
+                # decoder self-gates at 60s); a resident article picks
+                # up new params at its next chunk boundary (SERVING.md)
+                t_last = self._decoder.maybe_reload_checkpoint(t_last)
+            except Exception:
+                self._reg.counter("serve/ckpt_reload_errors_total").inc()
+                log.exception("between-chunk checkpoint reload failed; "
+                              "continuing on current params")
+                t_last = time.monotonic()
+
     def _dispatch(self, group: List[ServeRequest]) -> None:
         now = time.monotonic()
+        live: List[ServeRequest] = []
         for r in group:
             self._h_queue_time.observe(now - r.enqueue_t)
+            if r.deadline.expired():
+                # the ISSUE-6 bugfix, micro-batch side: a request whose
+                # budget died in the queue is resolved typed instead of
+                # burning a dispatch on an answer nobody is waiting for
+                self._c_evictions.inc()
+                r.future._reject(DeadlineExceededError(
+                    f"request {r.uuid!r} deadline expired while queued"))
+            else:
+                live.append(r)
+        group = live
+        if not group:
+            return
         try:
             with obs.spans.span(self._reg, "serve/dispatch",
                                 fill=len(group)):

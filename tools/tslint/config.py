@@ -42,6 +42,12 @@ DEFAULT: Dict[str, Any] = {
                 r"^DevicePrefetcher\.next_batch$",
                 r"^Batcher\.next_batch$",
                 r"^BeamSearchDecoder\.decode$",
+                # the continuous-serving dispatch path (ISSUE 6): one
+                # stray per-slot sync here serializes every resident
+                # request's chunk cadence
+                r"^ContinuousBatcher\.(tick|_refill|_harvest|_evict_expired)$",
+                r"^ServingServer\._run_continuous$",
+                r"^SlotDecodeEngine\.(pack|step|unpack)$",
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
