@@ -54,8 +54,12 @@ Modes (BENCH_MODE):
                     bytes accessed + intensity for the baseline config
                     and each byte-diet lever (--loss_chunk streaming
                     loss, bf16 optimizer state, both), with per-lever
-                    reduction ratios.  The CPU-verifiable side of the
-                    PERF.md "Byte diet" claims.
+                    reduction ratios.  Also emits decode rows (ISSUE 7,
+                    PERF.md "Decode byte diet"): bytes per emitted
+                    token + peak temp of the compiled beam search, per
+                    loop kind and for one slot-kernel chunk
+                    (BENCH_DECODE_CHUNK, default 25).  The
+                    CPU-verifiable side of the PERF.md byte-diet claims.
 
 Env overrides: BENCH_STEPS (20), BENCH_BATCH (16),
 BENCH_PRESET=tiny|scaled (smoke scale / the BASELINE configs[3]
@@ -137,12 +141,35 @@ def _child_env() -> dict:
         # host-only modes (bytes = XLA cost analysis, backend-portable by
         # design): never let a down TPU tunnel hang the child
         env["BENCH_PLATFORM"] = "cpu"
+    if env.get("BENCH_MODE") == "decode":
+        # pin the child's loop kind to the fingerprint's resolution (see
+        # _resolved_beam_loop): the measured program and the banked
+        # record's beam_loop axis can never diverge
+        env["TS_BEAM_LOOP"] = _resolved_beam_loop()
     if env.get("BENCH_PLATFORM", "").lower() == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("JAX_PLATFORM_NAME", None)
         pypath = strip_tpu_plugin_paths(env.get("PYTHONPATH", ""))
         env["PYTHONPATH"] = os.pathsep.join([repo_root] + pypath)
     return env
+
+
+def _resolved_beam_loop() -> str:
+    """The decode loop kind a BENCH_MODE=decode child will actually run,
+    resolved jax-free in the supervisor (importing jax here can hang
+    when the axon tunnel is down): an explicit TS_BEAM_LOOP wins;
+    otherwise forced-cpu children are direct-attached -> 'chunked', and
+    any other platform in this deployment reaches the device through
+    the RPC-proxied axon plugin -> 'scan' (beam_search._loop_kind's
+    ladder).  _child_env PINS the child's TS_BEAM_LOOP to this value,
+    so the fingerprint and the executed kind agree by construction —
+    the child never falls back to its own backend probe (whose
+    failure path resolves 'while') out from under the fingerprint."""
+    loop = (os.environ.get("TS_BEAM_LOOP", "auto") or "auto").lower()
+    if loop != "auto":
+        return loop
+    platform = (os.environ.get("BENCH_PLATFORM", "").lower() or "tpu")
+    return "chunked" if platform == "cpu" else "scan"
 
 
 def _env_flag(name: str) -> bool:
@@ -211,6 +238,10 @@ def _config_fingerprint() -> dict:
         fp["remat"] = _env_flag("BENCH_REMAT")
         if os.environ.get("BENCH_UNROLL"):
             fp["unroll"] = int(os.environ["BENCH_UNROLL"])
+        # the decode rows' slot/chunked programs change with the chunk
+        # length; non-default only, so banked records keep matching
+        if int(os.environ.get("BENCH_DECODE_CHUNK", "25")) != 25:
+            fp["decode_chunk"] = int(os.environ["BENCH_DECODE_CHUNK"])
     if mode in ("train", "trainer", "decode"):
         fp["batch"] = int(os.environ.get(
             "BENCH_BATCH", "4" if mode == "decode" else "16"))
@@ -274,7 +305,13 @@ def _config_fingerprint() -> dict:
         # dynamic iteration on the tunneled backend — never
         # cross-substitute their latencies (nor chunk sizes: C=1 is
         # per-step dynamic cost, C=T degenerates to scan)
-        loop = (os.environ.get("TS_BEAM_LOOP", "auto") or "auto").lower()
+        # record the RESOLVED kind, not "auto" (same rule as the
+        # pallas/flash axes): auto's meaning changed in ISSUE 7
+        # (attached backends now get chunked, not while), and an intent
+        # fingerprint would let a pre-change while record stand in for
+        # a chunked ask.  _child_env pins the child to this exact
+        # resolution, so measurement and fingerprint cannot diverge.
+        loop = _resolved_beam_loop()
         fp["beam_loop"] = loop
         # decode params source (VERDICT r4 weak #1): a trained fixture
         # and a STOP-biased init produce different generated-step counts,
@@ -1418,6 +1455,34 @@ def bench_bytes() -> None:
         sys.stderr.write(f"[bytes] compiling {name} ...\n")
         costs[name] = cost_of(hps)
     base = costs["baseline"]["bytes"]
+
+    # decode rows (ISSUE 7, PERF.md "Decode byte diet"): bytes per
+    # emitted token + peak temp of the compiled beam search at the same
+    # ask scale — the batch path per loop kind and one step_slots_jit
+    # slot chunk (the continuous-serving kernel).  Same single-counted
+    # loop-body caveat as the train rows; the committed gate-scale
+    # claims live in BYTE_BUDGET.json's decode section.
+    from __graft_entry__ import decode_step_cost
+
+    dec_hps = hps0.replace(mode="decode")
+    dec_chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "25"))
+    decode_rows = {}
+    for kind in ("scan", "chunked"):
+        sys.stderr.write(f"[bytes] compiling decode/{kind} ...\n")
+        c = decode_step_cost(dec_hps, loop=kind,
+                             chunk=dec_chunk if kind == "chunked" else None)
+        decode_rows[kind] = {
+            "bytes": c["bytes"],
+            "bytes_per_token": round(c["bytes_per_token"], 1),
+            "temp_bytes": c["temp_bytes"],
+        }
+    sys.stderr.write("[bytes] compiling decode/slot ...\n")
+    c = decode_step_cost(dec_hps, path="slot", chunk=dec_chunk)
+    decode_rows["slot"] = {
+        "bytes": c["bytes"],
+        "bytes_per_token": round(c["bytes_per_token"], 1),
+        "temp_bytes": c["temp_bytes"],
+    }
     # analytic collective bytes: one all-reduce of the full gradient tree
     # per step (2x on the wire for a ring, but the RATIO is what matters)
     state = jax.eval_shape(lambda: trainer_lib.init_train_state(
@@ -1448,6 +1513,8 @@ def bench_bytes() -> None:
             1.0 - costs["combined"]["bytes"] / base, 4),
         "grad_allreduce_bytes_f32": 4 * grad_elems,
         "grad_allreduce_bytes_bf16": 2 * grad_elems,
+        "decode": decode_rows,
+        "decode_chunk": dec_chunk,
         "loss_chunk": chunk,
         "batch": batch,
         "model_family": hps0.model_family,

@@ -83,4 +83,11 @@ echo "== roofline (XLA cost-model floors, tiny config)"
 # differently from the sweep rows, so a measured join could never match
 python scripts/roofline.py --configs train_tiny --bench /nonexistent
 
+echo "== decode-bytes smoke (backpointer beam-search byte accounting)"
+# the ISSUE-7 decode byte diet's cost path end to end: compiles the
+# restructured search at tiny scale and prints bytes/token + peak temp
+# (the committed gate-scale claims live in BYTE_BUDGET.json's decode
+# section, enforced by tests/test_bytes_gate.py in the suite above)
+python scripts/roofline.py --configs decode_bytes_tiny --bench /nonexistent
+
 echo "repro OK"

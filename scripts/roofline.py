@@ -78,6 +78,25 @@ CONFIGS = {
                                     "BENCH_LOSS_CHUNK": "25"},
 }
 
+# decode lever configs (ISSUE 7, PERF.md "Decode byte diet"): the
+# compiled beam search's bytes per emitted token + peak temp via
+# __graft_entry__.decode_step_cost — batch path (the auto 'chunked'
+# loop) and one step_slots_jit slot chunk per family, plus a tiny row
+# for the repro smoke.  The committed gate-scale reductions live in
+# BYTE_BUDGET.json's decode section; these rows put the ask-scale
+# numbers in the sweep record like the train lever rows above.
+DECODE_CONFIGS = {
+    "decode_bytes_pg": {"env": {}, "path": "batch"},
+    "decode_bytes_pg_slot": {"env": {}, "path": "slot"},
+    "decode_bytes_transformer": {"env": {"BENCH_FAMILY": "transformer"},
+                                 "path": "batch"},
+    "decode_bytes_transformer_slot": {
+        "env": {"BENCH_FAMILY": "transformer"}, "path": "slot"},
+    "decode_bytes_tiny": {"env": {"BENCH_PRESET": "tiny",
+                                  "BENCH_BATCH": "2", "BENCH_UNROLL": "1"},
+                          "path": "batch"},
+}
+
 _BENCH_ENV_VARS = ("BENCH_BATCH", "BENCH_PRESET", "BENCH_FAMILY",
                    "BENCH_UNROLL", "BENCH_REMAT", "BENCH_LOSS_CHUNK",
                    "BENCH_OPT_DTYPE")
@@ -96,12 +115,15 @@ def hps_for(tag: str, bench_mod):
     mapping + bench.bench_train's own construction."""
     from textsummarization_on_flink_tpu.config import HParams
 
+    env = (DECODE_CONFIGS[tag]["env"] if tag in DECODE_CONFIGS
+           else CONFIGS[tag])
     saved = {k: os.environ.pop(k, None) for k in _BENCH_ENV_VARS}
     try:
-        os.environ.update(CONFIGS[tag])
+        os.environ.update(env)
         batch = int(os.environ.get("BENCH_BATCH", "16"))
-        return HParams(batch_size=batch, compute_dtype="bfloat16",
-                       **bench_mod._preset_overrides())
+        hps = HParams(batch_size=batch, compute_dtype="bfloat16",
+                      **bench_mod._preset_overrides())
+        return hps.replace(mode="decode") if tag in DECODE_CONFIGS else hps
     finally:
         for k, v in saved.items():
             os.environ.pop(k, None)
@@ -161,6 +183,39 @@ def analyze(tag: str, chip: str, bench_mod, measured: dict | None):
             rec["measured_over_floor"] = round(ms / rec["min_step_ms"], 2)
             rec["measured_at"] = measured.get("captured_at")
     return rec
+
+
+def analyze_decode(tag: str, chip: str, bench_mod):
+    """A decode-bytes row: bytes/token + peak temp of the compiled beam
+    search, with the chip's bandwidth floor per emitted token (the
+    decode analogue of the train rows' min_step_ms)."""
+    from textsummarization_on_flink_tpu.config import beam_chunk_from_env
+    from __graft_entry__ import decode_step_cost
+
+    hps = hps_for(tag, bench_mod)
+    path = DECODE_CONFIGS[tag]["path"]
+    chunk = min(beam_chunk_from_env(), hps.max_dec_steps)
+    cost = decode_step_cost(hps, loop="chunked" if path == "batch" else "scan",
+                            chunk=chunk, path=path)
+    _, peak_gbps = CHIPS[chip]
+    t_bw_token = cost["bytes_per_token"] / (peak_gbps * 1e9)
+    return {
+        "config": tag,
+        "chip": chip,
+        "path": path,
+        "batch": hps.batch_size,
+        "family": hps.model_family,
+        "chunk": chunk,
+        "bytes_accessed": cost["bytes"],
+        "bytes_per_token": round(cost["bytes_per_token"], 1),
+        "temp_bytes": cost["temp_bytes"],
+        "bandwidth_floor_us_per_token": round(t_bw_token * 1e6, 3),
+        "max_tokens_per_sec": round(1.0 / max(t_bw_token, 1e-12), 1),
+        "note": "HloCostAnalysis single-counts the decode loop body, so "
+                "bytes/token tracks per-step traffic + loop-invariant "
+                "overhead; committed gate-scale reductions live in "
+                "BYTE_BUDGET.json decode",
+    }
 
 
 def _cost_of(fn, *args):
@@ -249,7 +304,9 @@ def main(argv=None):
     default_cfgs = ("train_b16,train_b16_remat,train_b64,train_scaled,"
                     "train_transformer,train_b16_losschunk,"
                     "train_b16_optbf16,train_b16_bytediet,"
-                    "train_transformer_losschunk")
+                    "train_transformer_losschunk,"
+                    "decode_bytes_pg,decode_bytes_pg_slot,"
+                    "decode_bytes_transformer,decode_bytes_transformer_slot")
     ap.add_argument("--configs", default=default_cfgs)
     ap.add_argument("--chip", default="v5e", choices=sorted(CHIPS))
     ap.add_argument("--json", action="store_true")
@@ -263,11 +320,17 @@ def main(argv=None):
     bench_mod = _load_bench()
     measured = measured_rows(args.bench)
     out = []
+    decode_out = []
     for tag in args.configs.split(","):
         tag = tag.strip()
+        if tag in DECODE_CONFIGS:
+            print(f"[roofline] compiling {tag} ...", file=sys.stderr)
+            decode_out.append(analyze_decode(tag, args.chip, bench_mod))
+            continue
         if tag not in CONFIGS:
             raise SystemExit(f"unknown config {tag!r}; "
-                             f"choose from {sorted(CONFIGS)}")
+                             f"choose from {sorted(CONFIGS)} or "
+                             f"{sorted(DECODE_CONFIGS)}")
         print(f"[roofline] compiling {tag} ...", file=sys.stderr)
         rec = analyze(tag, args.chip, bench_mod, measured.get(tag))
         if args.attribute:
@@ -277,7 +340,7 @@ def main(argv=None):
                                 "bytes": rec["bytes_accessed"]})
         out.append(rec)
     if args.json:
-        for rec in out:
+        for rec in out + decode_out:
             print(json.dumps(rec))
         return 0
     hdr = (f"{'config':<18} {'bound':<9} {'GFLOP':>8} {'GB':>7} "
@@ -285,7 +348,8 @@ def main(argv=None):
     print(f"roofline on one {args.chip} "
           f"({CHIPS[args.chip][0]:.0f} bf16 TFLOP/s, "
           f"{CHIPS[args.chip][1]:.0f} GB/s HBM)")
-    print(hdr)
+    if out:
+        print(hdr)
     for r in out:
         meas = (f"{r['measured_step_ms']:.1f}ms"
                 if "measured_step_ms" in r else "-")
@@ -294,6 +358,17 @@ def main(argv=None):
               f"{r['bytes_accessed'] / 1e9:>7.2f} "
               f"{r['min_step_ms']:>8.2f} "
               f"{r['max_samples_per_sec']:>9.0f} {meas:>9}")
+    if decode_out:
+        print("\ndecode byte accounting (loop body single-counted; "
+              "committed reductions in BYTE_BUDGET.json decode):")
+        print(f"{'config':<30} {'path':<6} {'KB/token':>9} "
+              f"{'peak temp MB':>13} {'floor us/tok':>13}")
+        for r in decode_out:
+            temp = (f"{r['temp_bytes'] / 1e6:.1f}"
+                    if r["temp_bytes"] is not None else "-")
+            print(f"{r['config']:<30} {r['path']:<6} "
+                  f"{r['bytes_per_token'] / 1e3:>9.1f} {temp:>13} "
+                  f"{r['bandwidth_floor_us_per_token']:>13.3f}")
     by_tag = {r["config"]: r for r in out}
     diet_rows = [(tag, base) for tag, base in _BYTE_DIET_BASELINES.items()
                  if tag in by_tag and base in by_tag]

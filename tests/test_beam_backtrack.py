@@ -1,0 +1,305 @@
+"""Backtrack-reconstruction parity vs a materialized-history mirror
+(ISSUE 7).
+
+The decode byte diet replaced the beam search's per-hypothesis
+trajectory buffers (tokens/attention/p_gen gathered by parent every
+step) with backpointer columns and a `_finalize_beam` backtrack.  This
+module re-implements the PRE-PR bookkeeping — full per-hypothesis
+buffers, host-side, gathered by parent each step — around the SAME
+jitted family step closures, so any disagreement isolates the
+backpointer/backtrack translation, not the numerics.  Pinned for BOTH
+model families across all three loop kinds and the slot kernels, plus
+the bf16 KV-cache drift envelope and the engine compile-count claim.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from test_beam_search import make_arrays
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.vocab import START_ID, STOP_ID, UNK_ID
+from textsummarization_on_flink_tpu.decode import beam_search
+from textsummarization_on_flink_tpu.models import get_family
+
+PG_HPS = HParams(batch_size=2, hidden_dim=8, emb_dim=6, vocab_size=24,
+                 max_enc_steps=12, max_dec_steps=8, beam_size=3,
+                 min_dec_steps=2, max_oov_buckets=4, mode="decode")
+TF_HPS = PG_HPS.replace(model_family="transformer", hidden_dim=8, emb_dim=8,
+                        num_heads=2, enc_layers=2, dec_layers=2)
+
+FAMILY_CASES = [
+    pytest.param("pointer_generator", PG_HPS, id="pg"),
+    pytest.param("transformer", TF_HPS, id="tf"),
+]
+
+
+@dataclasses.dataclass
+class Hyp:
+    """One materialized hypothesis: FULL token/attention/p_gen
+    trajectories carried explicitly — the pre-PR representation."""
+
+    tokens: list
+    lp: np.float32
+    attn: list  # one [T_enc] row per generated token
+    pgens: list
+    slot: int  # row in the stacked device state
+
+    @property
+    def avg(self):
+        return self.lp / len(self.tokens)
+
+
+def materialized_search(params, hps, family, arrays, b):
+    """The pre-PR search transliterated to the host: list-of-Hypothesis
+    with materialized histories, parent gathers via tree_map(x[parents])
+    on the family's opaque decode state, same triage order."""
+    enc_view = family.beam_encode(params, hps, arrays)
+    enc_one = jax.tree_util.tree_map(lambda x: x[b], enc_view)
+    mask = jnp.asarray(arrays["enc_padding_mask"][b])
+    ext = jnp.asarray(arrays["enc_batch_extend_vocab"][b])
+    init_state_fn, step_fn = family.beam_adapter(hps)
+    state = init_state_fn(params, enc_one)
+    step_jit = jax.jit(lambda t, latest, st: step_fn(
+        params, enc_one, mask, ext, t, latest, st))
+    K = hps.beam_size
+    hyps = [Hyp([START_ID], np.float32(0.0), [], [], i) for i in range(K)]
+    results = []
+    steps = 0
+    while steps < hps.max_dec_steps and len(results) < K:
+        latest = np.array([h.tokens[-1] for h in hyps], np.int32)
+        latest = np.where(latest >= hps.vocab_size, UNK_ID, latest)
+        out = step_jit(jnp.int32(steps), jnp.asarray(latest), state)
+        topk_ids = np.asarray(out.topk_ids)
+        topk_lp = np.asarray(out.topk_log_probs, np.float32)
+        attn = np.asarray(out.attn_dist)
+        pgen = np.asarray(out.p_gen)
+        cands = []  # hyp-major, like the device's stable argsort
+        num_orig = 1 if steps == 0 else K
+        for i in range(num_orig):
+            for j in range(2 * K):
+                cands.append((hyps[i], int(topk_ids[i, j]),
+                              np.float32(hyps[i].lp + topk_lp[i, j]), i))
+        new_hyps = []
+        for h, tok, lp, parent in sorted(cands, key=lambda c: -c[2]):
+            if tok == STOP_ID:
+                if steps >= hps.min_dec_steps:
+                    results.append(Hyp(h.tokens + [tok], lp,
+                                       h.attn + [attn[parent]],
+                                       h.pgens + [pgen[parent]], -1))
+            else:
+                new_hyps.append(Hyp(h.tokens + [tok], lp,
+                                    h.attn + [attn[parent]],
+                                    h.pgens + [pgen[parent]], parent))
+            if len(new_hyps) == K or len(results) == K:
+                break
+        if len(results) < K:
+            assert len(new_hyps) == K, "mirror beam underfilled"
+        parents = np.array(
+            [h.slot for h in new_hyps] + [0] * (K - len(new_hyps)),
+            np.int32)
+        state = jax.tree_util.tree_map(
+            lambda x: x[jnp.asarray(parents)], out.state)
+        for i, h in enumerate(new_hyps):
+            h.slot = i
+        hyps = new_hyps if new_hyps else hyps
+        steps += 1
+    pool = results if results else hyps
+    return sorted(pool, key=lambda h: h.avg, reverse=True)[0]
+
+
+def assert_matches_mirror(out, b, ref):
+    """Device BeamSearchOutput row b vs a mirror Hyp: tokens exact,
+    reconstructed attention/p_gen rows exact, zero-fill past the end."""
+    n = int(out.length[b])
+    assert list(np.asarray(out.tokens[b])[:n]) == ref.tokens
+    np.testing.assert_allclose(np.asarray(out.avg_log_prob[b]), ref.avg,
+                               rtol=2e-5, atol=2e-6)
+    gen = n - 1  # generated tokens incl a final STOP, if any
+    assert len(ref.attn) == gen
+    np.testing.assert_allclose(np.asarray(out.attn_dists[b])[:gen],
+                               np.stack(ref.attn), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out.p_gens[b])[:gen],
+                               np.array(ref.pgens), rtol=1e-5, atol=1e-6)
+    # rows past the trajectory are zero, exactly like the pre-PR buffers
+    np.testing.assert_array_equal(np.asarray(out.attn_dists[b])[gen:], 0.0)
+    np.testing.assert_array_equal(np.asarray(out.p_gens[b])[gen:], 0.0)
+
+
+@pytest.mark.parametrize("loop", ["while", "scan", "chunked"])
+@pytest.mark.parametrize("family_name,hps", FAMILY_CASES)
+def test_backtrack_matches_materialized_mirror(family_name, hps, loop):
+    """The tentpole parity claim: backpointer histories + the finalize
+    backtrack reproduce the materialized-history search token-exactly
+    (tokens, length, avg_log_prob, attn_dists, p_gens) for both model
+    families and every loop kind."""
+    family = get_family(family_name)
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(3))
+    arrays = make_arrays(hps, seed=6)
+    out = beam_search.run_beam_search_jit(
+        params, hps, arrays, loop=loop,
+        chunk=3 if loop == "chunked" else None)
+    for b in range(hps.batch_size):
+        ref = materialized_search(params, hps, family, arrays, b)
+        assert_matches_mirror(out, b, ref)
+
+
+@pytest.mark.parametrize("family_name,hps", FAMILY_CASES)
+def test_backtrack_matches_mirror_no_early_exit(family_name, hps):
+    """The live-beam fallback path of the backtrack (n_res == 0 at the
+    horizon): min_dec_steps near the horizon discards most STOPs, so
+    reconstruction anchors on the live beam."""
+    hps = hps.replace(min_dec_steps=hps.max_dec_steps - 1)
+    family = get_family(family_name)
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(5))
+    arrays = make_arrays(hps, seed=2)
+    out = beam_search.run_beam_search_jit(params, hps, arrays, loop="scan")
+    for b in range(hps.batch_size):
+        ref = materialized_search(params, hps, family, arrays, b)
+        assert_matches_mirror(out, b, ref)
+
+
+@pytest.mark.parametrize("family_name,hps", FAMILY_CASES)
+def test_slot_kernels_match_materialized_mirror(family_name, hps):
+    """The slot kernels (continuous serving) run the same backpointer
+    body per resident article: pack -> chunked steps -> unpack must
+    match the materialized mirror exactly, for both families."""
+    family = get_family(family_name)
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(3))
+    arrays = make_arrays(hps, seed=6)
+    slots = hps.batch_size
+    zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
+            for k, v in arrays.items()}
+    state = beam_search.init_slots_jit(params, hps, zero)
+    for slot in range(slots):
+        one = {k: v[slot:slot + 1] for k, v in arrays.items()}
+        state = beam_search.pack_slot_jit(params, hps, state, slot, one)
+    active = np.ones(slots, bool)
+    done = {}
+    for _ in range(16):
+        state, fin = beam_search.step_slots_jit(params, hps, state,
+                                                active, 3)
+        for s in np.nonzero(np.asarray(fin))[0]:
+            done[int(s)] = beam_search.unpack_slot_jit(hps, state, int(s))
+            active[s] = False
+        if not active.any():
+            break
+    assert sorted(done) == list(range(slots))
+    for b in range(slots):
+        out = done[b]
+        ref = materialized_search(params, hps, family, arrays, b)
+        n = int(out.length)
+        assert list(np.asarray(out.tokens)[:n]) == ref.tokens
+        np.testing.assert_allclose(np.asarray(out.avg_log_prob), ref.avg,
+                                   rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(out.attn_dists)[:n - 1],
+                                   np.stack(ref.attn), rtol=1e-5, atol=1e-6)
+
+
+class TestBf16KVCache:
+    """--decode_cache_dtype=bfloat16 (transformer): the cache narrows in
+    storage only — attention math stays f32 — with a pinned drift
+    envelope vs the f32 cache."""
+
+    def _outputs(self, dtype):
+        hps = TF_HPS.replace(decode_cache_dtype=dtype)
+        family = get_family("transformer")
+        params = family.init_params(hps, hps.vocab_size,
+                                    jax.random.PRNGKey(7))
+        arrays = make_arrays(hps, seed=4)
+        return beam_search.run_beam_search_jit(params, hps, arrays,
+                                               loop="scan")
+
+    def test_pg_family_ignores_cache_dtype(self):
+        """The LSTM family has no KV cache: bf16 must be a no-op."""
+        hps = PG_HPS.replace(decode_cache_dtype="bfloat16")
+        family = get_family("pointer_generator")
+        params = family.init_params(hps, hps.vocab_size,
+                                    jax.random.PRNGKey(7))
+        arrays = make_arrays(hps, seed=4)
+        a = beam_search.run_beam_search_jit(params, hps, arrays, loop="scan")
+        b = beam_search.run_beam_search_jit(
+            params, PG_HPS, arrays, loop="scan")
+        np.testing.assert_array_equal(np.asarray(a.tokens),
+                                      np.asarray(b.tokens))
+        np.testing.assert_array_equal(np.asarray(a.avg_log_prob),
+                                      np.asarray(b.avg_log_prob))
+
+    def test_bf16_cache_drift_envelope(self):
+        """End-to-end drift envelope: same params/articles decoded with
+        the f32 and bf16 caches must agree to bf16 resolution — the
+        searches emit valid trajectories whose per-article average
+        log-prob drifts by < 2e-2 (bf16 has ~3 significant digits; the
+        f32 softmax math keeps the rounding from compounding)."""
+        a = self._outputs("float32")
+        b = self._outputs("bfloat16")
+        np.testing.assert_allclose(np.asarray(a.avg_log_prob),
+                                   np.asarray(b.avg_log_prob), atol=2e-2)
+        assert np.asarray(b.length).min() >= 2
+        # attention rows remain distributions under the narrowed cache
+        for row, n in zip(np.asarray(b.attn_dists),
+                          np.asarray(b.length)):
+            np.testing.assert_allclose(row[: n - 1].sum(axis=-1), 1.0,
+                                       atol=1e-4)
+
+    def test_bf16_cache_single_step_envelope(self):
+        """One controlled adapter step, identical inputs, f32 vs bf16
+        cache: top-2K log-probs and attention within bf16 tolerance (the
+        direct storage-only claim, no search dynamics in the way)."""
+        family = get_family("transformer")
+        outs = {}
+        for dtype in ("float32", "bfloat16"):
+            hps = TF_HPS.replace(decode_cache_dtype=dtype)
+            params = family.init_params(hps, hps.vocab_size,
+                                        jax.random.PRNGKey(7))
+            arrays = make_arrays(hps, seed=4)
+            enc_view = family.beam_encode(params, hps, arrays)
+            enc_one = jax.tree_util.tree_map(lambda x: x[0], enc_view)
+            init_state_fn, step_fn = family.beam_adapter(hps)
+            state = init_state_fn(params, enc_one)
+            latest = jnp.full((hps.beam_size,), START_ID, jnp.int32)
+            out = step_fn(params, enc_one,
+                          jnp.asarray(arrays["enc_padding_mask"][0]),
+                          jnp.asarray(arrays["enc_batch_extend_vocab"][0]),
+                          jnp.int32(0), latest, state)
+            outs[dtype] = out
+        np.testing.assert_allclose(
+            np.asarray(outs["bfloat16"].topk_log_probs),
+            np.asarray(outs["float32"].topk_log_probs), atol=2e-2)
+        np.testing.assert_allclose(np.asarray(outs["bfloat16"].attn_dist),
+                                   np.asarray(outs["float32"].attn_dist),
+                                   atol=1e-2)
+        assert outs["bfloat16"].state["cache_k"].dtype == jnp.bfloat16
+        assert outs["float32"].state["cache_k"].dtype == jnp.float32
+
+
+def test_finalize_adds_at_most_one_compile_to_warm_set():
+    """ISSUE 7 acceptance detail: the backtrack lives INSIDE
+    unpack_slot_jit, so a fresh config still warms the slot engine with
+    exactly four compiles (init/pack/step/unpack) — the finalize pass
+    adds at most one executable (unpack's own), not a fifth kernel."""
+    # a config no other test compiles, so cache deltas are attributable
+    hps = PG_HPS.replace(max_oov_buckets=6, beam_size=2)
+    family = get_family("pointer_generator")
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(1))
+    arrays = make_arrays(hps, seed=8)
+    slots = 2
+    zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
+            for k, v in arrays.items()}
+    kernels = (beam_search.init_slots_jit, beam_search.pack_slot_jit,
+               beam_search.step_slots_jit, beam_search.unpack_slot_jit)
+    before = {f: f._cache_size() for f in kernels}
+    state = beam_search.init_slots_jit(params, hps, zero)
+    one = {k: v[0:1] for k, v in arrays.items()}
+    state = beam_search.pack_slot_jit(params, hps, state, 0, one)
+    state, _ = beam_search.step_slots_jit(params, hps, state,
+                                          np.array([True, False]), 2)
+    beam_search.unpack_slot_jit(hps, state, 0)
+    growth = {f.__wrapped__.__name__: f._cache_size() - before[f]
+              for f in kernels}
+    assert growth == {"init_slots_jit": 1, "pack_slot_jit": 1,
+                      "step_slots_jit": 1, "unpack_slot_jit": 1}, growth

@@ -224,16 +224,22 @@ def test_stale_fallback_rejects_unfingerprinted_records(tmp_path,
 
 
 def test_stale_fallback_platform_and_stale_guards(tmp_path, monkeypatch):
-    """(a) decode fingerprints carry the beam-loop axis; (b) a record
-    whose measured platform is cpu never satisfies a tpu ask even if the
-    env-intent fingerprint matches; (c) records already marked stale are
-    not fallback sources."""
+    """(a) decode fingerprints carry the RESOLVED beam-loop axis (an
+    'auto' ask resolves per platform — scan on the proxied tpu, chunked
+    on an attached cpu child — so a pre-ISSUE-7 auto=while record can
+    never stand in for today's auto); (b) a record whose measured
+    platform is cpu never satisfies a tpu ask even if the env-intent
+    fingerprint matches; (c) records already marked stale are not
+    fallback sources."""
     monkeypatch.setenv("BENCH_MODE", "decode")
     for var in ("BENCH_BATCH", "BENCH_PRESET", "BENCH_FAMILY",
                 "TS_PALLAS", "BENCH_PLATFORM", "TS_BEAM_LOOP"):
         monkeypatch.delenv(var, raising=False)
     fp = bench._config_fingerprint()
-    assert fp["beam_loop"] == "auto" and fp["platform"] == "tpu"
+    assert fp["beam_loop"] == "scan" and fp["platform"] == "tpu"
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+    assert bench._config_fingerprint()["beam_loop"] == "chunked"
+    monkeypatch.delenv("BENCH_PLATFORM")
     monkeypatch.setenv("TS_BEAM_LOOP", "while")
     assert bench._config_fingerprint() != fp
     monkeypatch.delenv("TS_BEAM_LOOP")

@@ -24,7 +24,7 @@ import os
 import pytest
 
 from textsummarization_on_flink_tpu.config import HParams
-from __graft_entry__ import train_step_cost
+from __graft_entry__ import decode_step_cost, train_step_cost
 
 BUDGET_PATH = os.path.join(os.path.dirname(__file__), "..",
                            "BYTE_BUDGET.json")
@@ -114,6 +114,103 @@ def test_peak_temp_floors_hold(budget, measured, lever):
         f"{lever}: peak-temp reduction vs {_BASE_OF[lever]} fell to "
         f"{reduction:.1%} (committed floor {floor:.1%}) — the scores "
         f"residual is materializing again")
+
+
+# --------------------------------------------------------------------------
+# Decode byte diet gate (ISSUE 7; PERF.md "Decode byte diet")
+# --------------------------------------------------------------------------
+#
+# Same contract as the train gate, for the compiled beam SEARCH: the
+# committed `decode` section pins bytes-per-emitted-token and peak-temp
+# budgets per family and loop kind (plus the step_slots_jit slot kernel)
+# against the PRE-PR materialized-history baseline measured before the
+# backpointer restructure landed.  A regression that reintroduces
+# per-step history gathers fails tier-1 on CPU, hardware or no hardware.
+
+_DECODE_KINDS = ("while", "scan", "chunked", "slot")
+
+
+def _decode_hps(budget, family: str) -> HParams:
+    gs = dict(budget["gate_scale"][family])
+    gs.update(budget["decode"]["gate_scale_overrides"])
+    return HParams(**gs)
+
+
+@pytest.fixture(scope="module")
+def decode_measured(budget):
+    """Compile each budgeted decode config once (~2-5s per program on
+    CPU; the persistent compile cache makes suite re-runs near-free)."""
+    chunk = int(budget["decode"]["chunk"])
+    out = {}
+    for family in ("pointer_generator", "transformer"):
+        hps = _decode_hps(budget, family)
+        out[family] = {
+            kind: (decode_step_cost(hps, path="slot", chunk=chunk)
+                   if kind == "slot"
+                   else decode_step_cost(
+                       hps, loop=kind,
+                       chunk=chunk if kind == "chunked" else None))
+            for kind in _DECODE_KINDS
+        }
+    return out
+
+
+def test_decode_budget_covers_every_kind(budget):
+    dec = budget["decode"]
+    for family in ("pointer_generator", "transformer"):
+        assert set(dec["budgets"][family]) == set(_DECODE_KINDS)
+        assert set(dec["baseline"][family]) == set(_DECODE_KINDS)
+
+
+@pytest.mark.parametrize("family", ["pointer_generator", "transformer"])
+def test_decode_bytes_per_token_within_budgets(budget, decode_measured,
+                                               family):
+    budgets = budget["decode"]["budgets"][family]
+    over = {
+        kind: (c["bytes_per_token"], budgets[kind]["max_bytes_per_token"])
+        for kind, c in decode_measured[family].items()
+        if c["bytes_per_token"] > budgets[kind]["max_bytes_per_token"]
+    }
+    assert not over, (
+        f"{family}: decode bytes-per-token regression past the committed "
+        f"budget: {over} (see BYTE_BUDGET.json decode._comment for the "
+        f"re-baselining rule)")
+
+
+@pytest.mark.parametrize("family", ["pointer_generator", "transformer"])
+@pytest.mark.parametrize("kind", _DECODE_KINDS)
+def test_decode_reduction_floors_hold(budget, decode_measured, family, kind):
+    """The backpointer-history claim: per-step search traffic dropped vs
+    the committed pre-PR (materialized-history) baseline and stays
+    dropped — >=25% bytes/token for every pointer-generator loop kind
+    (the ISSUE 7 acceptance floor), transformer floors from
+    measurement."""
+    floor = budget["decode"]["budgets"][family][kind]["min_reduction_vs_base"]
+    base = budget["decode"]["baseline"][family][kind]["bytes_per_token"]
+    reduction = 1.0 - decode_measured[family][kind]["bytes_per_token"] / base
+    assert reduction >= floor, (
+        f"{family}/{kind}: decode bytes-per-token reduction vs the pre-PR "
+        f"baseline fell to {reduction:.1%} (committed floor {floor:.1%}) — "
+        f"per-hypothesis history traffic is back")
+
+
+@pytest.mark.parametrize("family", ["pointer_generator", "transformer"])
+@pytest.mark.parametrize("kind", _DECODE_KINDS)
+def test_decode_peak_temp_floors_hold(budget, decode_measured, family, kind):
+    """Peak live-temp is the fusion- and loop-counting-independent
+    evidence the [K, T, T_enc] trajectory buffers (live + result pool +
+    candidate intermediates) no longer exist as materialized state."""
+    floor = budget["decode"]["budgets"][family][kind][
+        "min_temp_reduction_vs_base"]
+    base = budget["decode"]["baseline"][family][kind]["temp_bytes"]
+    temp = decode_measured[family][kind]["temp_bytes"]
+    if temp is None:
+        pytest.skip("backend provides no compiled memory stats")
+    reduction = 1.0 - temp / base
+    assert reduction >= floor, (
+        f"{family}/{kind}: decode peak-temp reduction vs the pre-PR "
+        f"baseline fell to {reduction:.1%} (committed floor {floor:.1%}) — "
+        f"the trajectory buffers are materializing again")
 
 
 def test_base_configs_are_vocab_dominated(budget, measured):

@@ -21,6 +21,23 @@ from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
 from textsummarization_on_flink_tpu.train import trainer as trainer_lib
 
 
+def _has_force_tpu_interpret() -> bool:
+    """The flash-interpret tests execute the Pallas TPU flash kernel on
+    CPU via pltpu.force_tpu_interpret_mode, which this jax build (0.4.x)
+    does not ship — skip them there (ISSUE 7 satellite) so tier-1
+    reports 0 failures and a real regression is visible again."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:  # pragma: no cover - pallas absent entirely
+        return False
+    return hasattr(pltpu, "force_tpu_interpret_mode")
+
+
+needs_force_tpu_interpret = pytest.mark.skipif(
+    not _has_force_tpu_interpret(),
+    reason="pltpu.force_tpu_interpret_mode is absent from this jax build")
+
+
 def tiny_hps(**kw) -> HParams:
     base = dict(model_family="transformer", hidden_dim=16, emb_dim=16,
                 batch_size=8, max_enc_steps=16, max_dec_steps=6, beam_size=2,
@@ -177,6 +194,7 @@ def test_flash_gating(monkeypatch):
     assert not tfm._use_flash(hps_big, 512)  # auto needs T >= 1024
 
 
+@needs_force_tpu_interpret
 def test_flash_branch_matches_einsum_interpret(monkeypatch):
     """Execute the ACTUAL flash branch (segment ids, head transposes,
     sm_scale) in Pallas interpret mode on CPU and compare real-row outputs
@@ -213,6 +231,7 @@ def test_flash_branch_matches_einsum_interpret(monkeypatch):
                                rtol=2e-3, atol=2e-3)
 
 
+@needs_force_tpu_interpret
 def test_flash_padded_unaligned_matches_einsum_interpret(monkeypatch):
     """TS_FLASH=on at UNALIGNED shapes (reference-class T=40, hd=32)
     zero-pads q/k/v to the 128 grid — fwd AND grad must match the
@@ -258,6 +277,7 @@ def test_flash_padded_unaligned_matches_einsum_interpret(monkeypatch):
 
 
 @pytest.mark.slow
+@needs_force_tpu_interpret
 def test_flash_grad_parity_bench_scale(monkeypatch):
     """The EXACT correctness gate bench.py's flash mode runs on hardware
     (fwd+bwd through a masked sum-of-squares loss at T=2048), executed in

@@ -211,6 +211,16 @@ class HParams:
     # default (beam_chunk_from_env, same source as the chunked beam
     # loop), clamped to max_dec_steps.
     serve_refill_chunk: int = 0
+    # ---- decode byte diet (PERF.md "Decode byte diet"; ISSUE 7) ----
+    # Transformer beam-search KV-cache storage dtype: "bfloat16" halves
+    # the per-hypothesis [K, L, T, nh, hd] self-attention cache — the
+    # dominant per-hypothesis resident tensor in continuous serving —
+    # and its per-step gather/re-read traffic.  The attention logits and
+    # softmax still run in f32 (the cache widens at the einsum), so only
+    # the HBM representation narrows; N-step drift vs the f32 cache is
+    # pinned by test.  The pointer-generator family has no KV cache and
+    # ignores this flag.
+    decode_cache_dtype: str = "float32"
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score
@@ -349,6 +359,10 @@ class HParams:
             raise ValueError(
                 f"loss_chunk must be >= 0 (0 = materialized loss), got "
                 f"{self.loss_chunk}")
+        if self.decode_cache_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"bad decode_cache_dtype {self.decode_cache_dtype!r} "
+                f"(float32/bfloat16)")
         if self.opt_state_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"bad opt_state_dtype {self.opt_state_dtype!r} "

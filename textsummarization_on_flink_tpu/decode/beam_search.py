@@ -28,12 +28,23 @@ Because live hypotheses all share the same length at any step, ordering by
 total log-prob during the search equals the reference's ordering by average
 log-prob; the average only matters for the final cross-length ranking.
 
-TPU-first details: all shapes are static — tokens/results live in
-`[beam, max_dec_steps+1]` buffers, the per-step candidate triage is a pure
-cumulative-sum computation over the `beam*2*beam` sorted candidates (no
-data-dependent Python), and a whole batch of B articles is searched per
-dispatch via `vmap`.  OOV ids are mapped back to UNK before the embedding
-lookup inside the loop (beam_search.py:112).
+TPU-first details: all shapes are static — the per-step candidate triage
+is a pure cumulative-sum computation over the `beam*2*beam` sorted
+candidates (no data-dependent Python), and a whole batch of B articles is
+searched per dispatch via `vmap`.  OOV ids are mapped back to UNK before
+the embedding lookup inside the loop (beam_search.py:112).
+
+Byte diet (ISSUE 7; PERF.md "Decode byte diet"): the loop body never
+materializes per-hypothesis trajectories.  Instead of gathering and
+rewriting full `[K, T]` token and `[K, T, T_enc]` attention histories
+through `x[parent]` every step (per-step traffic scaling with
+`beam x T_dec x T_enc`), each step appends ONE column of backpointers —
+parent slot, chosen token, and the step's raw attention/p_gen rows — at
+`[:, t]`, and a finished hypothesis is recorded as four scalars
+(log-prob, length, finish step, parent slot).  `_finalize_beam`
+reconstructs the single winning trajectory with one reverse `lax.scan`
+over the backpointer columns at the very end.  Token-exact with the
+materialized-history search (pinned by the parity suite).
 
 Model-family-agnostic: the search drives the (init_state, step) beam
 adapter of ``hps.model_family`` (models/__init__.get_family), carrying the
@@ -80,11 +91,17 @@ def _loop_kind(kind: Optional[str] = None) -> str:
     its early exit is free and saves the tail steps.
 
     TS_BEAM_LOOP=while|scan|chunked|auto; auto (the default) picks scan
-    when the backend is the RPC-proxied axon plugin, else while
-    (chunked is opt-in until the decode sweep row proves it).  The
-    resolved kind is logged once so a mis-detection is visible in decode
-    logs (ADVICE r2: JAX_PLATFORMS alone misses plugin
-    auto-registration).
+    when the backend is the RPC-proxied axon plugin, else chunked —
+    promoted into the auto ladder (ISSUE 7 satellite) now that the
+    tail-chunk parity suite (test_beam_search: chunk 1/3/5/13, the
+    no-early-exit regime, and the slot kernels) pins it token-exact:
+    on a direct-attached backend chunked keeps while's early exit at
+    chunk granularity while paying only ceil(T/C) dynamic iterations.
+    `while` stays available as the explicit fallback (TS_BEAM_LOOP=while)
+    and remains the degenerate safety default should backend probing
+    fail mid-init.  The resolved kind is logged once so a mis-detection
+    is visible in decode logs (ADVICE r2: JAX_PLATFORMS alone misses
+    plugin auto-registration).
     """
     kind = (kind or os.environ.get("TS_BEAM_LOOP", "auto")).lower()
     if kind == "auto":
@@ -95,9 +112,15 @@ def _loop_kind(kind: Optional[str] = None) -> str:
             # backend actually resolved (cheap after first init)
             try:
                 proxied = "axon" in jax.default_backend().lower()
-            except Exception:  # tslint: disable=TS005 — ANY backend-init failure must fall through to the 'while' default, never break decode
-                pass
-        kind = "scan" if proxied else "while"
+            except Exception:  # tslint: disable=TS005 — ANY backend-init failure must fall through to the conservative 'while' default, never break decode
+                if not _loop_kind_logged.get("while"):
+                    _loop_kind_logged["while"] = True
+                    import logging
+                    logging.getLogger(__name__).info(
+                        "beam decode loop auto-resolved to 'while' "
+                        "(backend probe failed)")
+                return "while"
+        kind = "scan" if proxied else "chunked"
         if not _loop_kind_logged.get(kind):
             _loop_kind_logged[kind] = True
             import logging
@@ -123,18 +146,34 @@ class BeamSearchOutput(NamedTuple):
 
 
 class _BeamState(NamedTuple):
+    """Per-article search state, backpointer representation (ISSUE 7).
+
+    History buffers (`parent_hist`/`tok_hist`/`attn_steps`/`pgen_steps`)
+    are append-only: each step writes ONE column at `[:, t]` and nothing
+    ever gathers them by parent — `_finalize_beam` backtracks the single
+    winning trajectory at the end.  Their width is T+1: column T is a
+    scratch column that masked (post-finish) loop iterations write into,
+    and columns >= the finish step are dead — never read by the
+    backtrack — so these buffers (and `dec_state`) stay OUT of the
+    masked-update select in `_masked_scan_body` (see `_SELECT_FIELDS`).
+    `attn_steps[:, t]` holds the step's raw attention rows indexed by the
+    PRE-expansion (parent) beam slot; `tok_hist[:, t]`/`parent_hist[:, t]`
+    are indexed by the post-expansion slot.
+    """
+
     t: Array  # scalar int32: decode step (reference's `steps`)
-    tokens: Array  # [K, T+1]
+    latest: Array  # [K] extended-vocab id of each live hyp's last token
     sum_lp: Array  # [K] total log prob of live hyps
     dec_state: Any  # model-family decode state; leaves lead with K
-    attn_hist: Array  # [K, T, T_enc]
-    pgen_hist: Array  # [K, T]
     n_res: Array  # scalar int32: filled result slots
-    res_tokens: Array  # [K+1, T+1] (row K is a scratch slot)
-    res_lp: Array  # [K+1]
+    parent_hist: Array  # [K, T+1] int32 parent slot per step
+    tok_hist: Array  # [K, T+1] int32 chosen token per step
+    attn_steps: Array  # [K, T+1, T_enc] raw per-parent-slot attention rows
+    pgen_steps: Array  # [K, T+1] raw per-parent-slot p_gen
+    res_lp: Array  # [K+1] (slot K is a scratch slot)
     res_len: Array  # [K+1] int32, token count incl START
-    res_attn: Array  # [K+1, T, T_enc]
-    res_pgen: Array  # [K+1, T]
+    res_t: Array  # [K+1] int32 finish step of each result
+    res_par: Array  # [K+1] int32 parent (pre-expansion) slot at finish
 
 
 def _init_beam_state(hps: HParams, T_enc: int, dec_state: Any) -> _BeamState:
@@ -144,17 +183,18 @@ def _init_beam_state(hps: HParams, T_enc: int, dec_state: Any) -> _BeamState:
     T = hps.max_dec_steps
     return _BeamState(
         t=jnp.zeros((), jnp.int32),
-        tokens=jnp.full((K, T + 1), STOP_ID, jnp.int32).at[:, 0].set(START_ID),
+        latest=jnp.full((K,), START_ID, jnp.int32),
         sum_lp=jnp.zeros((K,), jnp.float32),
         dec_state=dec_state,
-        attn_hist=jnp.zeros((K, T, T_enc), jnp.float32),
-        pgen_hist=jnp.zeros((K, T), jnp.float32),
         n_res=jnp.zeros((), jnp.int32),
-        res_tokens=jnp.zeros((K + 1, T + 1), jnp.int32),
+        parent_hist=jnp.zeros((K, T + 1), jnp.int32),
+        tok_hist=jnp.zeros((K, T + 1), jnp.int32),
+        attn_steps=jnp.zeros((K, T + 1, T_enc), jnp.float32),
+        pgen_steps=jnp.zeros((K, T + 1), jnp.float32),
         res_lp=jnp.full((K + 1,), NEG, jnp.float32),
         res_len=jnp.ones((K + 1,), jnp.int32),
-        res_attn=jnp.zeros((K + 1, T, T_enc), jnp.float32),
-        res_pgen=jnp.zeros((K + 1, T), jnp.float32),
+        res_t=jnp.zeros((K + 1,), jnp.int32),
+        res_par=jnp.zeros((K + 1,), jnp.int32),
     )
 
 
@@ -179,8 +219,8 @@ def _make_beam_body(params, hps: HParams, step_fn, enc_one, enc_mask,
     S = K * 2 * K  # candidate count per step
 
     def body(s: _BeamState) -> _BeamState:
-        latest = s.tokens[:, s.t]  # [K]
-        latest = jnp.where(latest >= V, UNK_ID, latest)  # beam_search.py:112
+        latest = jnp.where(s.latest >= V, UNK_ID,
+                           s.latest)  # beam_search.py:112
         step = step_fn(params, enc_one, enc_mask, ext_ids, s.t, latest,
                        s.dec_state)
         # candidate pool: every live hyp x its 2K continuations
@@ -210,55 +250,76 @@ def _make_beam_body(params, hps: HParams, step_fn, enc_one, enc_mask,
         sel = jnp.argsort(jnp.logical_not(live_sel))[:K]  # first K selected
         ok = live_sel[sel]  # all True unless results filled first
         par = parent[sel]
-        new_tokens = s.tokens[par].at[:, s.t + 1].set(srt_tok[sel])
+        new_latest = srt_tok[sel]
         new_sum_lp = jnp.where(ok, srt_lp[sel], NEG)
-        new_attn = s.attn_hist[par].at[:, s.t].set(step.attn_dist[par])
-        new_pgen = s.pgen_hist[par].at[:, s.t].set(step.p_gen[par])
 
-        # --- scatter finished hypotheses into result slots ---
+        # --- append ONE backpointer column (no history gathers) ---
+        # s.t == T only on masked post-horizon iterations; column T is
+        # the scratch column those writes land in (never read back)
+        parent_hist = s.parent_hist.at[:, s.t].set(par)
+        tok_hist = s.tok_hist.at[:, s.t].set(new_latest)
+        attn_steps = s.attn_steps.at[:, s.t].set(step.attn_dist)
+        pgen_steps = s.pgen_steps.at[:, s.t].set(step.p_gen)
+
+        # --- record finished hypotheses as scalar backpointers ---
         slot = jnp.where(res_sel, s.n_res + res_rank - 1, K)  # K = scratch
-        cand_tokens = s.tokens[parent].at[:, s.t + 1].set(srt_tok)  # [S, T+1]
-        cand_attn = s.attn_hist[parent].at[:, s.t].set(step.attn_dist[parent])
-        cand_pgen = s.pgen_hist[parent].at[:, s.t].set(step.p_gen[parent])
-        res_tokens = s.res_tokens.at[slot].set(cand_tokens)
         res_lp = s.res_lp.at[slot].set(jnp.where(res_sel, srt_lp, NEG))
         res_len = s.res_len.at[slot].set(s.t + 2)  # START + t+1 generated
-        res_attn = s.res_attn.at[slot].set(cand_attn)
-        res_pgen = s.res_pgen.at[slot].set(cand_pgen)
+        res_t = s.res_t.at[slot].set(s.t)
+        res_par = s.res_par.at[slot].set(parent)
         # scratch row K may hold garbage; restore invariants there
         res_lp = res_lp.at[K].set(NEG)
 
         return _BeamState(
             t=s.t + 1,
-            tokens=new_tokens,
+            latest=new_latest,
             sum_lp=new_sum_lp,
             dec_state=jax.tree_util.tree_map(lambda x: x[par], step.state),
-            attn_hist=new_attn,
-            pgen_hist=new_pgen,
             n_res=s.n_res + jnp.sum(res_sel).astype(jnp.int32),
-            res_tokens=res_tokens,
+            parent_hist=parent_hist,
+            tok_hist=tok_hist,
+            attn_steps=attn_steps,
+            pgen_steps=pgen_steps,
             res_lp=res_lp,
             res_len=res_len,
-            res_attn=res_attn,
-            res_pgen=res_pgen,
+            res_t=res_t,
+            res_par=res_par,
         )
 
     return body
 
 
+# the order-sensitive small leaves of _BeamState: the ONLY fields the
+# masked scan select protects.  The history buffers and dec_state stay
+# out on purpose (the decode byte diet's per-step win): a masked
+# iteration's garbage writes land in dead columns — the scratch column T
+# past the horizon, or the frozen-t column when the beam filled early,
+# neither of which the finalize backtrack ever reads — and dec_state is
+# never read again once cond(s) goes false.  Selecting them would re-read
+# and re-write the full [K, T, T_enc] histories every masked step,
+# reintroducing exactly the traffic the backpointer layout removes.
+_SELECT_FIELDS = ("t", "latest", "sum_lp", "n_res",
+                  "res_lp", "res_len", "res_t", "res_par")
+
+
 def _masked_scan_body(cond, body):
-    """Scan body with masked updates: once cond(s) goes false the state
-    is carried through unchanged, so the result is token-exact with the
-    while_loop (whose vmapped form does the same masking).  body's
-    garbage reads past the horizon (OOB gathers clamp, OOB scatter
-    writes drop) are discarded by the select."""
+    """Scan body with masked updates: once cond(s) goes false the
+    order-sensitive state is carried through unchanged, so the result is
+    token-exact with the while_loop (whose vmapped form masks every
+    leaf).  body's garbage outputs past the horizon are discarded by the
+    select (_SELECT_FIELDS) or land in dead history columns — see the
+    _SELECT_FIELDS comment."""
 
     def scan_body(s, _):
         s2 = body(s)
         keep = cond(s)
-        s = jax.tree_util.tree_map(
-            lambda old, new: jnp.where(keep, new, old), s, s2)
-        return s, None
+        kept = {
+            f: jax.tree_util.tree_map(
+                lambda old, new: jnp.where(keep, new, old),
+                getattr(s, f), getattr(s2, f))
+            for f in _SELECT_FIELDS
+        }
+        return s2._replace(**kept), None
 
     return scan_body
 
@@ -308,9 +369,11 @@ def _search_one(params, hps: HParams, init_state_fn, step_fn, loop, chunk,
 
 def _finalize_beam(hps: HParams, s: _BeamState, T_enc: int,
                    ) -> BeamSearchOutput:
-    """Rank the finished pool (falling back to the live beam) and emit
-    the best hypothesis — the reference's post-loop selection
-    (beam_search.py:158-168), shared by _search_one and unpack_slot_jit.
+    """Rank the finished pool (falling back to the live beam), then
+    reconstruct the ONE winning trajectory from the backpointer columns
+    with a single reverse `lax.scan` over T — the reference's post-loop
+    selection (beam_search.py:158-168) plus the ISSUE-7 backtrack pass.
+    Shared by _search_one and unpack_slot_jit.
     """
     K = hps.beam_size
     T = hps.max_dec_steps
@@ -321,24 +384,47 @@ def _finalize_beam(hps: HParams, s: _BeamState, T_enc: int,
                         s.res_lp)
     pool_len = jnp.where(use_live, jnp.full((K + 1,), live_len),
                          s.res_len)
-    pool_tokens = jnp.where(use_live,
-                            jnp.concatenate([s.tokens,
-                                             jnp.zeros((1, T + 1), jnp.int32)]),
-                            s.res_tokens)
-    pool_attn = jnp.where(
-        use_live,
-        jnp.concatenate([s.attn_hist, jnp.zeros((1, T, T_enc))]), s.res_attn)
-    pool_pgen = jnp.where(
-        use_live, jnp.concatenate([s.pgen_hist, jnp.zeros((1, T))]), s.res_pgen)
 
     avg = pool_lp / pool_len.astype(jnp.float32)  # beam_search.py:77-79
     avg = jnp.where(pool_lp <= NEG / 2, NEG, avg)  # keep empty slots last
     best = jnp.argmax(avg)
-    return BeamSearchOutput(tokens=pool_tokens[best],
+
+    # Backtrack anchors: the step that produced the winner's LAST token,
+    # the pre-expansion (parent) slot that produced it, and the token.
+    # A live winner's last token came from post-expansion slot `best` at
+    # step t-1 (t >= 1 always: the loop runs at least one step); a
+    # result's came from the recorded (res_t, res_par) with a STOP token.
+    live_slot = jnp.minimum(best, K - 1)  # best < K whenever live wins
+    live_last_t = jnp.maximum(s.t - 1, 0)
+    last_t = jnp.where(use_live, live_last_t, s.res_t[best])
+    last_parent = jnp.where(use_live,
+                            s.parent_hist[live_slot, live_last_t],
+                            s.res_par[best])
+    last_token = jnp.where(use_live, s.tok_hist[live_slot, live_last_t],
+                           STOP_ID)
+
+    def back(slot, t):
+        # carry: the post-expansion slot the trajectory occupies at step
+        # t (meaningful for t < last_t; re-anchored at t == last_t).
+        at_last = t == last_t
+        row_par = jnp.where(at_last, last_parent, s.parent_hist[slot, t])
+        tok = jnp.where(at_last, last_token, s.tok_hist[slot, t])
+        on_path = t <= last_t
+        tok_out = jnp.where(on_path, tok, STOP_ID)  # STOP-fill past the end
+        attn_row = jnp.where(on_path, s.attn_steps[row_par, t],
+                             jnp.zeros((T_enc,), jnp.float32))
+        pgen_val = jnp.where(on_path, s.pgen_steps[row_par, t], 0.0)
+        return jnp.where(on_path, row_par, slot), (tok_out, attn_row,
+                                                   pgen_val)
+
+    _, (toks, attn, pgens) = jax.lax.scan(
+        back, jnp.zeros((), jnp.int32), jnp.arange(T), reverse=True)
+    tokens = jnp.concatenate([jnp.array([START_ID], jnp.int32), toks])
+    return BeamSearchOutput(tokens=tokens,
                             length=pool_len[best],
                             avg_log_prob=avg[best],
-                            attn_dists=pool_attn[best],
-                            p_gens=pool_pgen[best])
+                            attn_dists=attn,
+                            p_gens=pgens)
 
 
 def _search_batch(params, hps: HParams, arrays: Dict[str, Array],
@@ -388,10 +474,19 @@ def run_beam_search_jit(params, hps: HParams, arrays: Dict[str, Array],
 #   * every kernel is shape-stable — slot index and active mask are
 #     TRACED arguments, so after the four warmup compiles NO request,
 #     slot choice, or occupancy pattern triggers a recompile;
-#   * per-slot activity masks: an inactive slot's state is carried
-#     through step_slots_jit unchanged (same masked-update select as the
-#     'chunked' batch loop, so a resident article's trajectory is
-#     token-exact with _search_one on the same inputs);
+#   * per-slot activity masks: an inactive slot's ORDER-SENSITIVE state
+#     (_SELECT_FIELDS: step counter, live beam, result pool) is carried
+#     through step_slots_jit unchanged — the same masked-update select
+#     as the 'chunked' batch loop, so a resident article's trajectory is
+#     token-exact with _search_one on the same inputs.  The history
+#     buffers and dec_state are NOT select-protected (the decode byte
+#     diet): a masked iteration writes garbage into them, confined to
+#     dead regions — the frozen-t / scratch column and a never-again-read
+#     dec_state — so an inactive slot's state is "unchanged" only where
+#     unpack_slot_jit reads, and a slot's leaves are trustworthy ONLY
+#     between pack and the step that finishes it (pack_slot_jit fully
+#     overwrites on reuse; do not snapshot or inspect a slot's raw state
+#     outside that window);
 #   * pack/unpack happen ONLY at chunk boundaries — the host never
 #     observes (or mutates) mid-chunk state.
 #
@@ -475,9 +570,13 @@ def step_slots_jit(params, hps: HParams, state: SlotState, active,
     active: [slots] bool.  Returns (state', finished) where finished[i]
     marks an active slot whose search is done (horizon reached or beam
     full of results) — the host retires it via unpack_slot_jit and may
-    refill.  Inactive slots run the same chunk on garbage state but
-    every update is discarded by the mask (the cost of shape stability;
-    a NaN in a dead lane never escapes the select)."""
+    refill.  Inactive slots run the same chunk on garbage state (the
+    cost of shape stability): every ORDER-SENSITIVE update is discarded
+    by the _SELECT_FIELDS mask — a NaN in a dead lane never escapes
+    into the selected leaves — while the dead lane's history columns
+    and dec_state DO take garbage writes, all confined to regions
+    unpack_slot_jit never reads and fully overwritten by the next
+    pack_slot_jit (see the slot-contract comment above)."""
     family = get_family(hps.model_family)
     _, step_fn = family.beam_adapter(hps)
     cond = _beam_cond(hps)

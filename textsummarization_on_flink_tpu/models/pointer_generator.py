@@ -384,6 +384,54 @@ def decode_onestep(params: Params, hps: HParams, enc: EncoderOutput,
                             coverage=cov)
 
 
+def decode_onestep_shared(params: Params, hps: HParams, enc_one: EncoderOutput,
+                          enc_mask: Array, ext_ids: Array,
+                          latest_tokens: Array, state: Tuple[Array, Array],
+                          prev_coverage: Array) -> DecodeStepOutput:
+    """decode_onestep with the PER-ARTICLE encoder view shared across
+    the K beam hypotheses (decode byte diet, ISSUE 7): enc_one leaves
+    are [T_enc, ...] with no hypothesis axis, enc_mask/ext_ids [T_enc].
+    The two attention queries broadcast against one encoder copy
+    (ops/attention.attend_shared) instead of the K-fold
+    `jnp.broadcast_to` the adapter used to materialize per step; only
+    genuinely per-hypothesis tensors (cell state, coverage, the
+    extended-vocab mixture) carry K.  Same decode-mode semantics
+    (initial_state_attention=True) step for step."""
+    dp = params["decoder"]
+    use_cov = hps.coverage
+    ctx_prev, _, cov = attn_ops.attend_shared(
+        dp["attention"], enc_one.enc_states, enc_one.enc_features, enc_mask,
+        state, prev_coverage if use_cov else None, use_cov)
+    if cov is None:
+        cov = prev_coverage
+    inp_emb = params["embedding"][latest_tokens]
+    x = _linear(dp["input_linear"], inp_emb, ctx_prev)
+    cell_out, new_state = lstm_ops.lstm_cell(dp["cell"], x, state)
+    context, attn_dist, _ = attn_ops.attend_shared(
+        dp["attention"], enc_one.enc_states, enc_one.enc_features, enc_mask,
+        new_state, cov if use_cov else None, use_cov)
+    p_gen = jax.nn.sigmoid(
+        _linear(dp["pgen_linear"], context, new_state[0], new_state[1], x))[:, 0]
+    output = _linear(dp["output_linear"], cell_out, context)
+    vocab_scores = _proj(hps, output, params["output_projection"]["w"]) + \
+        params["output_projection"]["v"]
+    vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
+    K = latest_tokens.shape[0]
+    if hps.pointer_gen:
+        # the mixture scatter is genuinely per-hypothesis; the broadcast
+        # ext ids are an int32 index operand, not a streamed tensor
+        ext_k = jnp.broadcast_to(ext_ids[None], (K,) + ext_ids.shape)
+        final_dist = final_distribution(hps, vocab_dist, attn_dist, p_gen,
+                                        ext_k)
+    else:
+        final_dist = vocab_dist
+    topk_probs, topk_ids = jax.lax.top_k(final_dist, 2 * hps.beam_size)
+    return DecodeStepOutput(topk_ids=topk_ids,
+                            topk_log_probs=jnp.log(topk_probs),
+                            state=new_state, attn_dist=attn_dist, p_gen=p_gen,
+                            coverage=cov)
+
+
 # --------------------------------------------------------------------------
 # Beam-search adapter protocol (shared by all model families)
 # --------------------------------------------------------------------------
@@ -425,18 +473,12 @@ def beam_adapter(hps: HParams):
     def step(params: Params, enc_one: EncoderOutput, enc_mask: Array,
              ext_ids: Array, t: Array, latest: Array, state) -> BeamStepOut:
         del t  # the LSTM state carries all positional context
-        T_enc = enc_one.enc_states.shape[0]
-        enc = EncoderOutput(
-            enc_states=jnp.broadcast_to(
-                enc_one.enc_states[None], (K,) + enc_one.enc_states.shape),
-            enc_features=jnp.broadcast_to(
-                enc_one.enc_features[None], (K,) + enc_one.enc_features.shape),
-            dec_in_state=(state["cell_c"], state["cell_h"]))
-        mask_k = jnp.broadcast_to(enc_mask[None], (K, T_enc))
-        ext_k = jnp.broadcast_to(ext_ids[None], (K, T_enc))
-        out = decode_onestep(params, hps, enc, mask_k, ext_k, latest,
-                             (state["cell_c"], state["cell_h"]),
-                             state["coverage"])
+        # per-article encoder view handed through UN-broadcast (decode
+        # byte diet): only cell state + coverage carry the K axis
+        out = decode_onestep_shared(params, hps, enc_one, enc_mask, ext_ids,
+                                    latest,
+                                    (state["cell_c"], state["cell_h"]),
+                                    state["coverage"])
         return BeamStepOut(
             topk_ids=out.topk_ids, topk_log_probs=out.topk_log_probs,
             attn_dist=out.attn_dist, p_gen=out.p_gen,

@@ -461,12 +461,19 @@ def beam_adapter(hps: HParams):
     L = hps.dec_layers
     nh, hd = hps.num_heads, _head_dim(hps)
     T = hps.max_dec_steps + 1
+    # --decode_cache_dtype=bfloat16 (decode byte diet, ISSUE 7): the
+    # cache is the dominant per-hypothesis resident tensor; bf16 storage
+    # halves it and its per-step traffic.  The einsums below widen to
+    # f32 before the logits/softmax, so the attention MATH is unchanged
+    # — only the HBM representation narrows (drift envelope pinned).
+    cache_dtype = (jnp.bfloat16 if hps.decode_cache_dtype == "bfloat16"
+                   else jnp.float32)
 
     def init_state(params: Params, enc_one: TransformerEncView):
         del params, enc_one
         return {
-            "cache_k": jnp.zeros((K, L, T, nh, hd), jnp.float32),
-            "cache_v": jnp.zeros((K, L, T, nh, hd), jnp.float32),
+            "cache_k": jnp.zeros((K, L, T, nh, hd), cache_dtype),
+            "cache_v": jnp.zeros((K, L, T, nh, hd), cache_dtype),
         }
 
     def step(params: Params, enc_one: TransformerEncView, enc_mask: Array,
@@ -484,10 +491,12 @@ def beam_adapter(hps: HParams):
             q = _split_heads(hps, h_norm @ p["wq"].astype(dt))  # [K, nh, hd]
             k_new = _split_heads(hps, h_norm @ p["wk"].astype(dt))
             v_new = _split_heads(hps, h_norm @ p["wv"].astype(dt))
-            cache_k = cache_k.at[:, li, t].set(k_new.astype(jnp.float32))
-            cache_v = cache_v.at[:, li, t].set(v_new.astype(jnp.float32))
-            kk = cache_k[:, li]  # [K, T, nh, hd]
-            vv = cache_v[:, li]
+            cache_k = cache_k.at[:, li, t].set(k_new.astype(cache_dtype))
+            cache_v = cache_v.at[:, li, t].set(v_new.astype(cache_dtype))
+            # widen the (possibly bf16) cache at the point of use: the
+            # logits einsum and softmax stay f32 whatever the storage
+            kk = cache_k[:, li].astype(jnp.float32)  # [K, T, nh, hd]
+            vv = cache_v[:, li].astype(jnp.float32)
             logits = jnp.einsum("knd,ktnd->knt", q.astype(jnp.float32), kk)
             logits = logits * (hd ** -0.5)
             logits = jnp.where(pos_ok[None, None, :] > 0, logits, -1e30)

@@ -83,3 +83,30 @@ def attend(attn_params: Dict[str, Array], enc_states: Array, enc_feats: Array,
     if use_coverage:
         new_coverage = (coverage if coverage is not None else 0.0) + attn_dist
     return context, attn_dist, new_coverage
+
+
+def attend_shared(attn_params: Dict[str, Array], enc_states: Array,
+                  enc_feats: Array, enc_mask: Array,
+                  dec_state: Tuple[Array, Array],
+                  coverage: Optional[Array], use_coverage: bool,
+                  ) -> Tuple[Array, Array, Optional[Array]]:
+    """attend() with the encoder tensors shared across the K query rows
+    (decode byte diet, ISSUE 7): enc_states/enc_feats [T, D] and
+    enc_mask [T] carry no query axis, dec_state leaves are [K, H],
+    coverage [K, T].  The beam search's per-hypothesis queries broadcast
+    against ONE per-article encoder copy — same numerics as attend() on
+    a K-fold broadcast, without the K-fold HBM stream."""
+    c, h = dec_state
+    dec_in = jnp.concatenate([c, h], axis=-1)
+    dec_feats = dec_in @ attn_params["linear_kernel"] + attn_params["linear_bias"]
+    apply_cov = bool(use_coverage and coverage is not None)
+    cov_in = (coverage if apply_cov
+              else jnp.zeros((dec_in.shape[0], enc_mask.shape[0]),
+                             jnp.float32))
+    context, attn_dist = pallas_attention.fused_attention_shared(
+        enc_states, enc_feats, enc_mask, dec_feats.astype(jnp.float32),
+        cov_in, attn_params["v"], attn_params["w_c"], apply_cov)
+    new_coverage = None
+    if use_coverage:
+        new_coverage = (coverage if coverage is not None else 0.0) + attn_dist
+    return context, attn_dist, new_coverage

@@ -110,6 +110,45 @@ def _attention_xla(enc_states, enc_feats, enc_mask, dec_feats, coverage,
     return context, attn
 
 
+def _attention_xla_shared(enc_states, enc_feats, enc_mask, dec_feats,
+                          coverage, v, w_c, use_coverage):
+    """The reference formula with the per-article encoder tensors SHARED
+    across the K query rows (decode byte diet, ISSUE 7): enc_states /
+    enc_feats are [T, D] and enc_mask [T] — no query axis — so the beam's
+    K hypotheses broadcast against ONE copy and the context reduction is
+    a plain [K, T] @ [T, D] matmul that streams the encoder from HBM
+    once per step instead of K times.  dec_feats: [K, D]; coverage:
+    [K, T].  Same math as _attention_xla row for row."""
+    feats = enc_feats[None, :, :] + dec_feats[:, None, :]
+    if use_coverage:
+        feats = feats + coverage[:, :, None] * w_c[None, None, :]
+    e = jnp.sum(v * jnp.tanh(feats), axis=-1)  # [K, T]
+    e = jnp.where(enc_mask[None, :] > 0, e, NEG)
+    e = e - jax.lax.stop_gradient(jnp.max(e, axis=-1, keepdims=True))
+    p = jnp.exp(e) * (enc_mask[None, :] > 0)
+    # fully-masked row: clamp the l=0 denominator (match the kernels)
+    attn = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    context = attn @ enc_states  # promotes bf16 enc to f32 like the einsum
+    return context, attn
+
+
+def fused_attention_shared(enc_states, enc_feats, enc_mask, dec_feats,
+                           coverage, v, w_c, use_coverage):
+    """fused_attention for the shared-encoder decode layout (enc leaves
+    carry no query axis; see _attention_xla_shared).  Forward-only — the
+    beam search never differentiates through it.  TS_PALLAS=on keeps its
+    meaning by broadcasting the encoder back to [K, ...] for the kernel
+    (the kernel's grid is per query row); the default XLA path never
+    materializes that broadcast."""
+    if _use_pallas():
+        K = dec_feats.shape[0]
+        bc = lambda x: jnp.broadcast_to(x[None], (K,) + x.shape)  # noqa: E731
+        return fused_attention(bc(enc_states), bc(enc_feats), bc(enc_mask),
+                               dec_feats, coverage, v, w_c, use_coverage)
+    return _attention_xla_shared(enc_states, enc_feats, enc_mask, dec_feats,
+                                 coverage, v, w_c, use_coverage)
+
+
 def _attention_pallas(enc_states, enc_feats, enc_mask, dec_feats, coverage,
                       v, w_c, use_coverage, interpret=False):
     from jax.experimental import pallas as pl

@@ -48,6 +48,12 @@ DEFAULT: Dict[str, Any] = {
                 r"^ContinuousBatcher\.(tick|_refill|_harvest|_evict_expired)$",
                 r"^ServingServer\._run_continuous$",
                 r"^SlotDecodeEngine\.(pack|step|unpack)$",
+                # the decode byte diet's restructured search (ISSUE 7):
+                # the backpointer body and the finalize backtrack are the
+                # per-step/per-retire hot code — one stray host sync (or
+                # trace-time side effect) here serializes every dispatch
+                r"^_make_beam_body",  # covers the <locals>.body closure
+                r"^_finalize_beam",  # covers the <locals>.back backtrack
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
