@@ -178,6 +178,24 @@ def _env_flag(name: str) -> bool:
     return os.environ.get(name, "").lower() in ("1", "on", "true", "yes")
 
 
+def _bench_mesh() -> tuple:
+    """BENCH_MESH="dpXtp" (e.g. "4x2") -> (dp, tp); (1, 1) when unset.
+    Jax-free (the supervisor's fingerprint parses it with the tunnel
+    down); a malformed spec fails loudly here, at config time."""
+    spec = os.environ.get("BENCH_MESH", "").strip().lower()
+    if not spec:
+        return (1, 1)
+    try:
+        dp, tp = (int(x) for x in spec.split("x"))
+    except ValueError:
+        raise ValueError(
+            f"BENCH_MESH must be 'dpXtp' (e.g. '4x2'), got {spec!r}"
+        ) from None
+    if dp < 1 or tp < 1:
+        raise ValueError(f"BENCH_MESH axes must be >= 1, got {spec!r}")
+    return (dp, tp)
+
+
 def _obs_snapshot_requested() -> bool:
     """`python bench.py --obs-snapshot` (or TS_OBS_SNAPSHOT=1): embed a
     compact obs registry dump in the result row so the BENCH trajectory
@@ -210,6 +228,14 @@ def _config_fingerprint() -> dict:
     else:
         fp["platform"] = (os.environ.get("BENCH_PLATFORM", "").lower()
                           or "tpu")
+    mesh = _bench_mesh()
+    if mesh != (1, 1):
+        # sharded-mesh axis (ISSUE 8): a dp x tp measurement is a
+        # different compiled program (registry-driven collectives) and
+        # must never stand in for a single-device ask.  Added only when
+        # non-default so pre-existing banked records (no such key) keep
+        # matching default asks.
+        fp["mesh"] = f"{mesh[0]}x{mesh[1]}"
     if mode in ("train", "trainer"):
         # byte-diet lever axes (ISSUE 5): each is a DIFFERENT compiled
         # program, so rows must never cross-substitute.  Added only when
@@ -697,6 +723,12 @@ def _preset_overrides() -> dict:
         # recomputing the [T_dec, B, V] scores block in backward may SAVE
         # time, not just memory
         out["remat"] = True
+    mesh = _bench_mesh()
+    if mesh != (1, 1):
+        # (dp, tp) mesh axes for the unified sharded step (ISSUE 8):
+        # the registry-driven layouts are different compiled programs,
+        # fingerprinted via the `mesh` axis below
+        out["dp"], out["tp"] = mesh
     family = os.environ.get("BENCH_FAMILY", "")
     if family:
         out["model_family"] = family
@@ -1430,10 +1462,7 @@ def bench_bytes() -> None:
     bytes/step; reduction_* fields carry the lever claims the byte-budget
     gate (BYTE_BUDGET.json, tests/test_bytes_gate.py) enforces in tier-1.
     """
-    import jax
-
     from textsummarization_on_flink_tpu.config import HParams
-    from textsummarization_on_flink_tpu.train import trainer as trainer_lib
     from __graft_entry__ import train_step_cost as cost_of
 
     batch = int(os.environ.get("BENCH_BATCH", "16"))
@@ -1483,12 +1512,19 @@ def bench_bytes() -> None:
         "bytes_per_token": round(c["bytes_per_token"], 1),
         "temp_bytes": c["temp_bytes"],
     }
-    # analytic collective bytes: one all-reduce of the full gradient tree
-    # per step (2x on the wire for a ring, but the RATIO is what matters)
-    state = jax.eval_shape(lambda: trainer_lib.init_train_state(
-        hps0, hps0.vocab_size, seed=0))
-    grad_elems = sum(int(np.prod(x.shape))
-                     for x in jax.tree_util.tree_leaves(state.params))
+    # analytic collective bytes from the sharding registry (ISSUE 8):
+    # the dp gradient all-reduce moves the registry's per-device
+    # reduction set each step (2x on the wire for a ring, but the RATIO
+    # is what matters); on a tp mesh (BENCH_MESH) sharded leaves ride
+    # the wire as shards
+    from textsummarization_on_flink_tpu.parallel import (
+        sharding as sharding_lib,
+    )
+
+    comms_f32 = sharding_lib.analytic_comms(
+        hps0.replace(grad_allreduce_dtype="float32"))
+    comms_bf16 = sharding_lib.analytic_comms(
+        hps0.replace(grad_allreduce_dtype="bfloat16"))
     _, info = _device_info()
     rec = {
         "metric": "train_step_bytes_accessed",
@@ -1511,8 +1547,8 @@ def bench_bytes() -> None:
             1.0 - costs["opt_bf16"]["bytes"] / base, 4),
         "reduction_combined": round(
             1.0 - costs["combined"]["bytes"] / base, 4),
-        "grad_allreduce_bytes_f32": 4 * grad_elems,
-        "grad_allreduce_bytes_bf16": 2 * grad_elems,
+        "grad_allreduce_bytes_f32": comms_f32["dp_wire_bytes"],
+        "grad_allreduce_bytes_bf16": comms_bf16["dp_wire_bytes"],
         "decode": decode_rows,
         "decode_chunk": dec_chunk,
         "loss_chunk": chunk,

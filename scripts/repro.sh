@@ -51,6 +51,14 @@ jax.jit(fn).lower(*args)
 print('entry() traces ok')
 g.dryrun_multichip(8)"
 
+echo "== one-mesh smoke (dp x tp train + serve on the faked 8-device mesh)"
+# the ISSUE-8 registry end to end: unified sharded step at dp=4 x tp=2
+# with bf16 gradient wire + loss_chunk + bf16 opt state, then the same
+# rows through BOTH serving engines at dp=2 x tp=2 with single-device
+# row parity (the committed collective-byte claims live in
+# BYTE_BUDGET.json's comms section, enforced in the suite above)
+python scripts/mesh_smoke.py
+
 echo "== serve smoke (CollectionSource -> ServingServer -> CollectionSink)"
 # the concurrent serving path (SERVING.md) over the 8 synthetic rows,
 # BOTH dispatch engines: micro-batch (queue admission, coalescing,

@@ -10,13 +10,17 @@ multi-worker tests at all, SURVEY §4; this rebuild claims the capability
 so it must prove it).
 
 Usage (spawned by the test, not by hand):
-    python _multiproc_worker.py <port> <process_id> <workdir> [dp,tp]
+    python _multiproc_worker.py <port> <process_id> <workdir> [dp,tp[,wire]]
 
 [dp,tp] defaults to "4,1" (pure data parallelism, replicated params —
 the easy checkpoint gather).  "2,2" additionally shards params over the
 tp axis ACROSS the two hosts, so the collective checkpoint gather must
 fetch non-addressable shards (checkpointer.state_to_arrays's
-process_allgather path) — the hard case.
+process_allgather path) — the hard case.  An optional third component
+("2,2,bfloat16") sets --grad_allreduce_dtype, running the unified
+step's wire-annotated gradient all-reduce (ISSUE 8) across the two
+REAL processes — the dp x tp composition the retired shard_map path
+rejected.
 """
 
 import json
@@ -26,8 +30,9 @@ import sys
 
 def main() -> int:
     port, pid, workdir = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3])
-    dp, tp = (int(x) for x in (sys.argv[4] if len(sys.argv) > 4
-                               else "4,1").split(","))
+    parts = (sys.argv[4] if len(sys.argv) > 4 else "4,1").split(",")
+    dp, tp = int(parts[0]), int(parts[1])
+    wire = parts[2] if len(parts) > 2 else "float32"
 
     import jax
     import numpy as np
@@ -64,6 +69,7 @@ def main() -> int:
     hps = HParams(batch_size=8, max_enc_steps=6, max_dec_steps=5,
                   min_dec_steps=1, hidden_dim=4, emb_dim=3,
                   max_oov_buckets=2, vocab_size=0, dp=dp, tp=tp,
+                  grad_allreduce_dtype=wire,
                   log_root=workdir, exp_name="mp")
     # 8 words + 4 specials = vocab 12: divisible by tp=2 for the
     # sharded-projection variant

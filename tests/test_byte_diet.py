@@ -309,8 +309,12 @@ class TestConfigValidation:
             HParams(opt_state_dtype="fp8").validate()
         with pytest.raises(ValueError, match="grad_allreduce_dtype"):
             HParams(grad_allreduce_dtype="fp8").validate()
-        with pytest.raises(ValueError, match="pure-dp"):
-            HParams(grad_allreduce_dtype="bfloat16", tp=2).validate()
+        # tp now composes with the bf16 wire (ISSUE 8 unification); sp
+        # still rejects
+        HParams(grad_allreduce_dtype="bfloat16", tp=2).validate()
+        with pytest.raises(ValueError, match="sp"):
+            HParams(grad_allreduce_dtype="bfloat16", sp=2,
+                    max_enc_steps=400).validate()
         with pytest.raises(ValueError, match="pointer_gen"):
             HParams(grad_allreduce_dtype="bfloat16",
                     pointer_gen=False).validate()

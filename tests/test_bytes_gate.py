@@ -24,7 +24,7 @@ import os
 import pytest
 
 from textsummarization_on_flink_tpu.config import HParams
-from __graft_entry__ import decode_step_cost, train_step_cost
+from __graft_entry__ import decode_step_cost, train_step_comms, train_step_cost
 
 BUDGET_PATH = os.path.join(os.path.dirname(__file__), "..",
                            "BYTE_BUDGET.json")
@@ -211,6 +211,99 @@ def test_decode_peak_temp_floors_hold(budget, decode_measured, family, kind):
         f"{family}/{kind}: decode peak-temp reduction vs the pre-PR "
         f"baseline fell to {reduction:.1%} (committed floor {floor:.1%}) — "
         f"the trajectory buffers are materializing again")
+
+
+# --------------------------------------------------------------------------
+# One-mesh comms gate (ISSUE 8; PERF.md "One mesh")
+# --------------------------------------------------------------------------
+#
+# The unified sharded step's per-step collective bytes, enforced per mesh
+# shape from the committed `comms` section: on wire=bf16 meshes the
+# dp-axis all-reduce must move exactly the registry-predicted gradient
+# elements (the retired lowp shard_map path's reduction set), priced at
+# the registry wire dtype; tp overhead stays under committed ceilings.
+
+
+@pytest.fixture(scope="module")
+def comms_measured(budget):
+    """Compile the unified step once per committed mesh shape (~3-6s
+    each on CPU; persistent compile cache makes re-runs near-free)."""
+    gs = budget["gate_scale"]["pointer_generator"]
+    out = {}
+    for name, entry in budget["comms"]["meshes"].items():
+        hps = HParams(**gs).replace(**entry["overrides"])
+        hps.validate()
+        out[name] = train_step_comms(hps)
+    return out
+
+
+def test_comms_ref_scale_analytic_pins_lowp_wire_bytes(budget):
+    """The headline equality: at reference scale the unified step's dp
+    gradient wire carries the retired lowp path's committed 43.0 MB/step
+    under the bf16 annotation (86.0 at f32) — registry analytics, no
+    compile."""
+    from textsummarization_on_flink_tpu.parallel import (
+        sharding as sharding_lib,
+    )
+
+    ref = budget["comms"]["ref_dp_wire_mb"]
+    for wire, want_mb in ref.items():
+        hps = HParams(batch_size=16, compute_dtype="bfloat16",
+                      grad_allreduce_dtype=wire)
+        got = sharding_lib.analytic_comms(hps)["dp_wire_bytes"] / 1e6
+        assert round(got, 1) == want_mb, (
+            f"analytic ref-scale dp wire bytes at {wire} drifted to "
+            f"{got:.2f} MB (committed {want_mb}) — the registry's "
+            f"reduction set no longer matches the retired lowp path's")
+
+
+@pytest.mark.parametrize("mesh_name", ["dp4_bf16", "dp2_tp2_bf16"])
+def test_comms_dp_elements_match_registry_exactly(budget, comms_measured,
+                                                  mesh_name):
+    """Wire-annotated meshes reduce EXACTLY the registry's predicted
+    gradient elements over dp (slack covers only the scalar metric
+    pmeans): nothing double-reduced, nothing skipped, on pure-dp AND
+    dp x tp — the restriction the shard_map step had is gone."""
+    slack = budget["comms"]["element_slack"]
+    c = comms_measured[mesh_name]
+    want = c["analytic"]["dp_grad_elements"]
+    got = c["dp"]["elements"]
+    assert want <= got <= want + slack, (
+        f"{mesh_name}: dp all-reduce moves {got} elements/step, registry "
+        f"predicts {want} (+{slack} scalar slack) — the unified step's "
+        f"reduction set drifted from the registry spec")
+
+
+@pytest.mark.parametrize("mesh_name", ["dp4_bf16", "dp2_tp2_bf16",
+                                       "dp2_tp2_f32"])
+def test_comms_wire_bytes_within_ceilings(budget, comms_measured, mesh_name):
+    entry = budget["comms"]["meshes"][mesh_name]
+    c = comms_measured[mesh_name]
+    assert c["dp_wire_bytes"] <= entry["max_dp_wire_bytes"], (
+        f"{mesh_name}: dp wire bytes {c['dp_wire_bytes']} over the "
+        f"committed ceiling {entry['max_dp_wire_bytes']}")
+    assert c["tp"]["bytes_hlo"] <= entry["max_tp_bytes_hlo"], (
+        f"{mesh_name}: tp collective bytes {c['tp']['bytes_hlo']} over "
+        f"the committed ceiling {entry['max_tp_bytes_hlo']}")
+
+
+def test_comms_no_stray_axes(budget, comms_measured):
+    """No sp or mixed-group collectives on the committed meshes: every
+    collective is attributable to the axis the registry assigns it."""
+    for name, c in comms_measured.items():
+        assert c["sp"]["instructions"] == 0, (name, c["sp"])
+        assert c["mixed"]["instructions"] == 0, (name, c["mixed"])
+
+
+def test_comms_bf16_wire_halves_dp_bytes(comms_measured):
+    """The annotation is the lever: same mesh, same reduction set —
+    wire bytes halve from f32 to bf16 (identical element counts would
+    be ideal, but the f32 path lets GSPMD pick its own reduction
+    placement, so assert the priced ratio on the registry analytics)."""
+    b = comms_measured["dp2_tp2_bf16"]["analytic"]
+    f = comms_measured["dp2_tp2_f32"]["analytic"]
+    assert b["dp_grad_elements"] == f["dp_grad_elements"]
+    assert b["dp_wire_bytes"] * 2 == f["dp_wire_bytes"]
 
 
 def test_base_configs_are_vocab_dominated(budget, measured):

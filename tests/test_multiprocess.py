@@ -39,13 +39,16 @@ def _worker_env() -> dict:
     return env
 
 
-@pytest.mark.parametrize("mesh", ["4,1", "2,2"])
+@pytest.mark.parametrize("mesh", ["4,1", "2,2", "2,2,bfloat16"])
 @pytest.mark.slow
 def test_two_process_distributed_train_checkpoint_resume(tmp_path, mesh):
     """mesh='4,1': pure dp, replicated params (easy checkpoint gather).
     mesh='2,2': params tp-shard ACROSS the two hosts, so the collective
     save must gather non-addressable shards — the hard path of
-    checkpointer.state_to_arrays."""
+    checkpointer.state_to_arrays.  mesh='2,2,bfloat16': the same shape
+    with the registry's bf16 gradient wire annotation (ISSUE 8) — the
+    dp x tp composition the retired shard_map builder rejected, now
+    running its bf16 dp all-reduce across two real processes."""
     port = _free_port()
     env = _worker_env()
     procs = [

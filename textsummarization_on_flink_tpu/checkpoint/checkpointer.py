@@ -19,6 +19,13 @@ pytree under its slash-joined key path (``params/decoder/attention/W_h``,
 ``opt_state/accumulators/...``, ``step``), plus a small JSON sidecar of
 hparams for provenance.  Arrays are gathered to host before writing
 (multi-host callers save on the chief only, parallel/distributed.is_chief).
+
+Mesh story (ISSUE 8): a sharded TrainState saves through the same path —
+the host-local gather in ``state_to_arrays`` assembles full arrays from
+whatever layout the sharding registry (parallel/sharding.py) placed them
+in, so checkpoints are mesh-shape-agnostic; ``restore_sharded`` places a
+restored state onto ANY mesh against the registry specs (save at
+dp4 x tp2, resume at dp2 x tp2, bit-identical after gather).
 """
 
 from __future__ import annotations
@@ -453,6 +460,32 @@ class Checkpointer:
         except OSError:  # pragma: no cover
             pass
         return state
+
+    def restore_sharded(self, plan: Any,
+                        path: Optional[str] = None,
+                        ) -> Optional[TrainState]:
+        """Restore and place onto `plan`'s mesh against the sharding
+        registry's specs (ISSUE 8: one mesh story).
+
+        Checkpoints are mesh-shape-agnostic: save() gathers shards to
+        full host arrays (state_to_arrays), so a state saved from a
+        dp4 x tp2 mesh restores onto dp2 x tp2 — or any other shape the
+        registry can lay it out on — with bit-identical values after a
+        gather.  When the registry's hps store the Adagrad accumulators
+        in bf16, they are re-narrowed BEFORE placement (npz cannot hold
+        bf16, so save() widened them losslessly to f32) — the same
+        widen/narrow round trip the Trainer applies on resume.
+        """
+        state = self.restore(path)
+        if state is None:
+            return None
+        from textsummarization_on_flink_tpu.train import (
+            trainer as trainer_lib,
+        )
+
+        registry = plan.registry
+        state = trainer_lib.cast_opt_state(registry.hps, state)
+        return registry.shard_state(state)
 
 
 class BestModelSaver:

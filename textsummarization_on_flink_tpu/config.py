@@ -104,10 +104,13 @@ class HParams:
     # f32 masters.  N-step drift vs f32 is pinned by test.
     opt_state_dtype: str = "float32"
     # dp-gradient all-reduce dtype: "bfloat16" halves the per-step
-    # gradient collective bytes (the psum is issued explicitly in
-    # parallel/mesh.py via shard_map; f32 everywhere else).  Requires a
-    # pure-dp mesh (tp=sp=1) and pointer_gen losses (whose per-example
-    # normalization makes shard-mean == global-mean exactly).
+    # gradient collective bytes.  A registry-level wire annotation
+    # (parallel/sharding.py): the unified step stacks per-dp-group
+    # grads under a P("dp", ...) constraint in this dtype and XLA's
+    # partitioner inserts the dp all-reduce at it; f32 everywhere
+    # else.  Works on any dp x tp mesh; requires sp=1 and pointer_gen
+    # losses (whose per-example normalization makes group-mean ==
+    # global-mean exactly).
     grad_allreduce_dtype: str = "float32"
     # rematerialize transformer layers in backward (jax.checkpoint):
     # trades ~1/3 more FLOPs for O(layers) less activation HBM — for the
@@ -372,12 +375,11 @@ class HParams:
                 f"bad grad_allreduce_dtype {self.grad_allreduce_dtype!r} "
                 f"(float32/bfloat16)")
         if self.grad_allreduce_dtype == "bfloat16":
-            if self.tp > 1 or self.sp > 1:
+            if self.sp > 1:
                 raise ValueError(
-                    "grad_allreduce_dtype=bfloat16 issues the dp gradient "
-                    "psum explicitly via shard_map, which supports pure-dp "
-                    "meshes only (tp=sp=1); the tp/sp collectives inside "
-                    "forward stay on the pjit path")
+                    "grad_allreduce_dtype=bfloat16 supports dp x tp "
+                    "meshes (sp=1): the per-group gradient vmap does not "
+                    "compose with sequence-parallel attention's shard_map")
             if not self.pointer_gen:
                 raise ValueError(
                     "grad_allreduce_dtype=bfloat16 requires pointer_gen "

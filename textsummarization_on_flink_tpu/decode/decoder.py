@@ -483,6 +483,15 @@ class SlotDecodeEngine:
     ``_params_snapshot()``, so a between-batch reload lands at the NEXT
     chunk boundary — resident articles finish under the new params
     (documented in SERVING.md; same shapes, so no recompile).
+
+    Multi-chip serving (ISSUE 8): on a dp x tp mesh the resident
+    [slots, ...] state shards over dp and params tp-shard, both against
+    the sharding registry (parallel/sharding.py) — the same layout
+    story as training and the micro-batch sharded search.  Slots must
+    divide by dp.  The kernels themselves are unchanged: sharded inputs
+    compile to a sharded program, and the engine re-pins the state to
+    the registry specs after each step so GSPMD's output layout can
+    never drift from the registry's.
     """
 
     def __init__(self, decoder: BeamSearchDecoder, slots: int, chunk: int):
@@ -499,6 +508,44 @@ class SlotDecodeEngine:
         self._state = None  # lazy: first pack pays the init compile
         self._active = np.zeros(slots, dtype=bool)
         self._obs = obs.registry_for(self._hps)
+        self._registry = None
+        # (source params tree, its registry-placed copy): holding the
+        # source object keeps its id live, so the identity check below
+        # can never false-hit on a recycled address after a hot-swap
+        self._placed_params: Optional[Tuple[Any, Any]] = None
+        hps = self._hps
+        if hps.dp * hps.tp * hps.sp > 1:
+            if slots % hps.dp != 0:
+                raise ValueError(
+                    f"continuous serving shards resident slots over dp: "
+                    f"dp={hps.dp} must divide serve slots={slots}")
+            # the decoder already built the mesh plan under the same
+            # condition — engine and micro-batch search share ONE
+            # mesh/registry by construction
+            self._registry = decoder._mesh_plan.registry
+
+    def _params(self):
+        """The decoder's params snapshot, placed against the registry's
+        param specs on a mesh (cached per swapped-in params object, so
+        a checkpoint hot-swap re-places once, not per chunk)."""
+        params, _ = self._dec._params_snapshot()
+        if self._registry is None:
+            return params
+        if self._placed_params is None or self._placed_params[0] is not params:
+            self._placed_params = (params,
+                                   self._registry.shard_params(params))
+        return self._placed_params[1]
+
+    def _pin_state(self, state):
+        """Pin the resident state to the registry's slots-over-dp specs
+        (a no-op transfer when the layout already matches)."""
+        if self._registry is None:
+            return state
+        import jax
+
+        reg = self._registry
+        return jax.device_put(
+            state, reg.shardings(reg.slot_state_specs(state)))
 
     def _jitted(self, fn, *args, **kw):
         """Run a slot kernel, mirroring run_beam_search's compile-cache
@@ -529,21 +576,30 @@ class SlotDecodeEngine:
             "enc_batch_extend_vocab": np.zeros((self.slots, self._t_enc),
                                                np.int32),
         }
-        self._state = self._jitted(beam_search.init_slots_jit, params,
-                                   self._hps, zero)
+        if self._registry is not None:
+            import jax
+
+            reg = self._registry
+            specs = reg.slot_batch_specs()
+            zero = {k: jax.device_put(v, reg.named(specs[k]))
+                    for k, v in zero.items()}
+        self._state = self._pin_state(
+            self._jitted(beam_search.init_slots_jit, params,
+                         self._hps, zero))
 
     def pack(self, idx: int, example) -> None:
         """Admit one SummaryExample into slot `idx` (must be free)."""
         if self._active[idx]:
             raise AssertionError(f"slot {idx} is already resident")
-        params, _ = self._dec._params_snapshot()
+        params = self._params()
         self._ensure_state(params)
         batch = Batch([example], self._hps1, self._dec._vocab,
                       enc_steps=self._t_enc)
         arrays = {k: v for k, v in batch.as_arrays().items()
                   if k.startswith("enc_")}
-        self._state = self._jitted(beam_search.pack_slot_jit, params,
-                                   self._hps, self._state, idx, arrays)
+        self._state = self._pin_state(
+            self._jitted(beam_search.pack_slot_jit, params,
+                         self._hps, self._state, idx, arrays))
         self._active[idx] = True
 
     def step(self) -> List[int]:
@@ -551,10 +607,11 @@ class SlotDecodeEngine:
         whose search finished (ready to unpack)."""
         if not self._active.any():
             return []
-        params, _ = self._dec._params_snapshot()
+        params = self._params()
         self._state, finished = self._jitted(
             beam_search.step_slots_jit, params, self._hps, self._state,
             self._active, self.chunk)
+        self._state = self._pin_state(self._state)
         # the one sanctioned chunk-boundary sync: the host scheduler
         # needs the finished mask to retire and refill slots
         return [int(i) for i in np.nonzero(np.asarray(finished))[0]]

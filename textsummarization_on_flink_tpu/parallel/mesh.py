@@ -1,4 +1,4 @@
-"""Device mesh + sharding layer: SPMD data/tensor parallelism via pjit.
+"""Device mesh + sharded step builders: SPMD data/tensor parallelism.
 
 This replaces the reference's entire distributed stack — the TF1
 parameter-server/worker cluster (`ClusterSpec`/`tf.train.Server`/
@@ -21,6 +21,12 @@ program over a `jax.sharding.Mesh`:
     sp shards the *attention/feature* tensors, which dominate memory at
     long T_enc.)
 
+Layout decisions do NOT live here: every PartitionSpec comes from the
+sharding-spec registry (parallel/sharding.py, ISSUE 8) — one declarative
+role -> spec (+ wire dtype) table consumed by the step builders below,
+the serving paths, the checkpointer, and bench alike.  The step builders
+in this module construct no specs of their own (pinned by test).
+
 There is no parameter server and no coordination store to configure: in a
 multi-host deployment `jax.distributed.initialize()` (distributed.py) is
 the rendezvous, and collectives ride ICI within a slice / DCN across
@@ -35,25 +41,27 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Any, Dict, Optional, Sequence, Tuple
+import warnings
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401 — P re-exported for callers/tests
 
 from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.parallel import sharding as sharding_lib
 from textsummarization_on_flink_tpu.train import trainer as trainer_lib
 
 PyTree = Any
 
 log = logging.getLogger(__name__)
 
-MESH_AXES = ("dp", "tp", "sp")
+MESH_AXES = sharding_lib.MESH_AXES
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
-    """A mesh plus the sharding rules derived from it."""
+    """A mesh plus the hps its sharding registry derives from."""
 
     mesh: Mesh
     hps: HParams
@@ -70,8 +78,12 @@ class MeshPlan:
     def sp(self) -> int:
         return self.mesh.shape["sp"]
 
+    @property
+    def registry(self) -> sharding_lib.ShardingRegistry:
+        return sharding_lib.registry_for(self)
+
     def named(self, spec: P) -> NamedSharding:
-        return NamedSharding(self.mesh, spec)
+        return self.registry.named(spec)
 
 
 def make_mesh(hps: HParams, devices: Optional[Sequence[jax.Device]] = None,
@@ -96,88 +108,50 @@ def make_mesh(hps: HParams, devices: Optional[Sequence[jax.Device]] = None,
 
 
 # --------------------------------------------------------------------------
-# Sharding rules
+# Registry delegates (public API preserved; the specs live in sharding.py)
 # --------------------------------------------------------------------------
 
 def param_pspecs(params: PyTree) -> PyTree:
-    """PartitionSpec tree for a model-family parameter pytree.
-
-    Pointer-generator: vocab-dimension tensors shard over `tp`; everything
-    else (LSTM kernels, attention, reduce — all small: ~[384,1024] at the
-    default config) is replicated, which keeps their per-step all-reduce
-    traffic at zero.
-
-    Transformer: the tied [V, H] embedding and [V] out_bias shard over
-    vocab; attention wq/wk/wv and ffn w1 column-shard (heads/ffn over tp),
-    wo and ffn w2 row-shard — the Megatron layout, so each attention/FFN
-    block needs exactly one all-reduce on its output.
-    """
-
-    def spec_for(path: Tuple[Any, ...], leaf: Any) -> P:
-        keys = tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
-        if "embedding" in keys:
-            return P("tp", None)  # [V, E|H] row-sharded over vocab
-        if "output_projection" in keys:
-            if keys[-1] == "w":
-                return P(None, "tp")  # [H, V] column-sharded over vocab
-            return P("tp")  # bias v: [V]
-        if keys[-1] == "out_bias":
-            return P("tp")  # transformer tied-projection bias [V]
-        if keys[-1] in ("wq", "wk", "wv", "w1"):
-            return P(None, "tp")  # heads / ffn hidden over tp
-        if keys[-1] in ("wo", "w2"):
-            return P("tp", None)  # row-parallel back to H
-        if keys[-1] == "b1":
-            return P("tp")  # ffn hidden bias [F]
-        return P()
-
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+    """PartitionSpec tree for a model-family parameter pytree (the
+    registry's per-leaf param rule; see sharding.param_spec)."""
+    return sharding_lib.param_specs(params)
 
 
 def batch_pspec(name: str) -> P:
-    """Batch arrays shard over dp on axis 0; encoder-sequence-major arrays
-    additionally shard T_enc over sp (context parallelism)."""
-    if name in ("enc_batch", "enc_padding_mask", "enc_batch_extend_vocab"):
-        return P("dp", "sp")
-    return P("dp")
+    return sharding_lib.batch_spec(name)
 
 
 def batch_sharding(plan: MeshPlan) -> Dict[str, NamedSharding]:
-    names = ("enc_batch", "enc_lens", "enc_padding_mask",
-             "enc_batch_extend_vocab", "dec_batch", "target_batch",
-             "dec_padding_mask")
-    return {k: plan.named(batch_pspec(k)) for k in names}
+    reg = plan.registry
+    return reg.shardings(reg.batch_specs())
 
 
 def state_pspecs(state: trainer_lib.TrainState) -> trainer_lib.TrainState:
-    """PartitionSpecs for the full TrainState: params and the Adagrad
-    accumulators (same tree structure -> same specs); scalar step is
-    replicated."""
-    pspecs = param_pspecs(state.params)
-    acc_specs = param_pspecs(state.opt_state.accumulators)
-    return trainer_lib.TrainState(
-        params=pspecs,
-        opt_state=type(state.opt_state)(accumulators=acc_specs),
-        step=P(),
-    )
+    """PartitionSpecs for the full TrainState (registry state rule)."""
+    return sharding_lib.state_specs(state)
 
 
 def shard_train_state(plan: MeshPlan,
                       state: trainer_lib.TrainState) -> trainer_lib.TrainState:
     """Place a host-resident TrainState onto the mesh."""
-    specs = state_pspecs(state)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, plan.named(s)), state, specs,
-        is_leaf=lambda x: isinstance(x, P))
+    return plan.registry.shard_state(state)
 
 
 def shard_batch(plan: MeshPlan, arrays: Dict[str, Any]) -> Dict[str, Any]:
-    return {k: jax.device_put(v, plan.named(batch_pspec(k)))
-            for k, v in arrays.items()}
+    return plan.registry.shard_batch(arrays)
+
+
+def param_shardings(plan: MeshPlan, params: Optional[PyTree] = None):
+    """NamedSharding tree for a parameter pytree; pass `params` when its
+    structure differs from a fresh init (e.g. TF1-imported trees)."""
+    probe = params if params is not None else jax.eval_shape(
+        lambda: trainer_lib.init_train_state(
+            plan.hps, plan.hps.vocab_size, seed=0)).params
+    return plan.registry.shardings(sharding_lib.param_specs(probe))
 
 
 # --------------------------------------------------------------------------
-# Sharded step functions
+# The unified sharded step
 # --------------------------------------------------------------------------
 
 def _with_mesh_context(plan: MeshPlan, fn):
@@ -191,52 +165,110 @@ def _with_mesh_context(plan: MeshPlan, fn):
 
     return wrapped
 
-def param_shardings(plan: MeshPlan, params: Optional[PyTree] = None):
-    """NamedSharding tree for a parameter pytree; pass `params` when its
-    structure differs from a fresh init (e.g. TF1-imported trees)."""
-    probe = params if params is not None else jax.eval_shape(
-        lambda: trainer_lib.init_train_state(
-            plan.hps, plan.hps.vocab_size, seed=0)).params
-    return jax.tree_util.tree_map(
-        lambda s: plan.named(s), param_pspecs(probe),
-        is_leaf=lambda x: isinstance(x, P))
+
+def _make_wire_grad_fn(plan: MeshPlan, reg: sharding_lib.ShardingRegistry,
+                       param_spec_tree: PyTree):
+    """(params, arrays) -> (grads, scalar losses) with the dp gradient
+    all-reduce riding the wire in the registry's annotated dtype.
+
+    Mechanism (ISSUE 8; see sharding.py's module docstring for why the
+    shard_map route is closed on this jax): the batch regroups
+    ``[B] -> [dp, B/dp]`` under a `P("dp", ...)` constraint, per-group
+    grads come from ONE vmap'd jax.grad (each dp shard computes exactly
+    its local rows, as under shard_map), the stacked grads are cast to
+    the wire dtype under a ``P("dp", *param_spec)`` constraint, and the
+    group-axis sum is partitioned by XLA into the dp all-reduce at that
+    dtype — spec-level wire annotation, collective inserted by the
+    partitioner.  f32 is restored before clip/Adagrad; forward-internal
+    tp collectives stay wherever GSPMD puts them, which is what makes
+    this compose with dp x tp meshes (the retired shard_map step was
+    pure-dp-only).
+
+    Requirements (validated in HParams.validate and here): sp == 1, and
+    pointer_gen losses — their per-example normalization makes the
+    mean of per-group means exactly the global mean, so the wire cast
+    is the ONLY difference from the f32 step (parity pinned by test).
+    """
+    import jax.numpy as jnp
+
+    hps = plan.hps
+    if plan.sp > 1:
+        raise ValueError(
+            "grad_allreduce_dtype=bfloat16 supports dp x tp meshes "
+            f"(sp=1), got sp={plan.sp}")
+    if not hps.pointer_gen:
+        raise ValueError(
+            "grad_allreduce_dtype=bfloat16 requires pointer_gen losses "
+            "(group-mean == global-mean); the baseline CE normalizes by "
+            "the global token count")
+    loss_fn = trainer_lib.make_loss_fn(hps)
+    wire = reg.wire_dtype("grads")
+    dp = plan.dp
+
+    def grad_fn(params, arrays):
+        def regroup(name, v):
+            v = v.reshape((dp, v.shape[0] // dp) + v.shape[1:])
+            return reg.constrain(v, reg.grouped_batch_spec(name))
+
+        grouped = {k: regroup(k, v) for k, v in arrays.items()}
+
+        def one_group(group_arrays):
+            grads, out = jax.grad(
+                lambda p: loss_fn(p, group_arrays),
+                has_aux=True)(params)
+            return grads, (out.loss, out.coverage_loss, out.total_loss)
+
+        grads, scal = jax.vmap(one_group)(grouped)
+        # THE lever: stacked per-group grads pinned to the registry's
+        # stacked-grad spec in the wire dtype, so the group-axis sum
+        # lowers to the dp all-reduce at that dtype; f32 restored
+        # before any update math
+        grads = jax.tree_util.tree_map(
+            lambda g, s: reg.constrain(g.astype(wire),
+                                       reg.stacked_grad_spec(s)),
+            grads, param_spec_tree, is_leaf=lambda x: isinstance(x, P))
+        grads = jax.tree_util.tree_map(
+            lambda g: g.sum(axis=0).astype(jnp.float32) / dp, grads)
+        return grads, tuple(jnp.mean(s) for s in scal)
+
+    return grad_fn
 
 
 def make_sharded_train_step(plan: MeshPlan, donate: bool = True,
                             state: Optional[trainer_lib.TrainState] = None):
-    """pjit the train step over the mesh.
+    """THE sharded train step: one jitted program whose in/out shardings
+    come from the sharding registry and whose body is the single
+    trainer_lib.make_train_step body.
 
-    The step function is the same pure function as single-device
-    (train/trainer.make_train_step); sharding is expressed entirely through
-    in/out shardings, and XLA inserts the dp-axis gradient psum, the
-    tp-axis collectives around the vocab matmuls, and the sp-axis context
-    reductions.  This is the whole "distributed backend".
+    Sharding is expressed entirely through registry specs — XLA inserts
+    the dp-axis gradient psum, the tp-axis collectives around the vocab
+    matmuls, and the sp-axis context reductions.  When the registry
+    annotates a grad wire dtype (``--grad_allreduce_dtype=bfloat16``)
+    the gradient computation swaps to the wire variant above — same
+    step body, half the per-step dp collective bytes, now on any
+    dp x tp mesh (the separate pure-dp shard_map builder is retired;
+    see make_lowp_allreduce_train_step's shim).
 
-    ``--grad_allreduce_dtype=bfloat16`` switches to an explicit-collective
-    variant (make_lowp_allreduce_train_step) where the dp gradient psum is
-    issued by hand in bf16 — half the per-step collective bytes.
-
-    Pass `state` when its pytree structure differs from a fresh init (e.g.
-    a TF1-imported non-coverage checkpoint has no decoder/attention/w_c
-    leaf); specs are derived from the given tree so pjit's in_shardings
-    structure matches.
+    Pass `state` when its pytree structure differs from a fresh init
+    (e.g. a TF1-imported non-coverage checkpoint has no
+    decoder/attention/w_c leaf); specs are derived from the given tree
+    so the jit's in_shardings structure matches.
     """
     hps = plan.hps
-    if getattr(hps, "grad_allreduce_dtype", "float32") == "bfloat16":
-        return make_lowp_allreduce_train_step(plan, donate=donate,
-                                              state=state)
-    step_fn = _with_mesh_context(plan, trainer_lib.make_train_step(hps))
+    reg = plan.registry
     probe = state if state is not None else jax.eval_shape(
         # structure only, nothing allocated
         lambda: trainer_lib.init_train_state(hps, hps.vocab_size, seed=0))
-    state_sh = jax.tree_util.tree_map(
-        lambda s: plan.named(s), state_pspecs(probe),
-        is_leaf=lambda x: isinstance(x, P))
+    grad_fn = None
+    if reg.wire_dtype("grads") is not None:
+        grad_fn = _make_wire_grad_fn(plan, reg,
+                                     sharding_lib.param_specs(probe.params))
+    step_fn = _with_mesh_context(
+        plan, trainer_lib.make_train_step(hps, grad_fn=grad_fn))
+    state_sh = reg.shardings(reg.state_specs(probe))
     del probe
-    batch_sh = batch_sharding(plan)
-    metric_sh = trainer_lib.StepMetrics(
-        loss=plan.named(P()), coverage_loss=plan.named(P()),
-        total_loss=plan.named(P()), global_norm=plan.named(P()))
+    batch_sh = reg.shardings(reg.batch_specs())
+    metric_sh = reg.shardings(reg.metric_specs())
     return jax.jit(
         step_fn,
         in_shardings=(state_sh, batch_sh),
@@ -248,75 +280,23 @@ def make_sharded_train_step(plan: MeshPlan, donate: bool = True,
 def make_lowp_allreduce_train_step(
         plan: MeshPlan, donate: bool = True,
         state: Optional[trainer_lib.TrainState] = None):
-    """Data-parallel train step with the dp gradient all-reduce issued
-    EXPLICITLY in a low-precision dtype (--grad_allreduce_dtype=bfloat16).
-
-    The pjit path's gradient psum is inserted by XLA's partitioner in the
-    gradients' own dtype (f32) and cannot be narrowed from the outside,
-    so this variant runs the whole step under shard_map over the dp axis:
-    each shard computes grads on its local batch rows, the per-leaf psum
-    is cast to bf16 for the wire and widened back to f32 immediately
-    after (clipping/Adagrad/params all stay f32), and the optimizer
-    update replays identically on every shard.  Per-step collective bytes
-    halve — the roofline lever PERF.md's byte-diet section measures.
-
-    Restrictions (validated here and in HParams.validate):
-      * pure-dp mesh (tp=sp=1) — forward-internal tp/sp collectives stay
-        on the pjit path;
-      * pointer_gen losses — their per-example normalization makes the
-        mean-of-shard-means exactly the global mean, so the bf16 cast is
-        the ONLY difference from the pjit step (parity pinned by test).
-    """
-    import jax.numpy as jnp
-
+    """DEPRECATED shim (ISSUE 8 satellite): the explicit-collective
+    shard_map step this built is retired — the unified builder folds the
+    bf16 gradient wire in as a registry-level dtype annotation and works
+    on dp x tp meshes the shard_map step rejected.  Kept so existing
+    callers resolve; delegates to make_sharded_train_step with the wire
+    dtype forced on."""
+    warnings.warn(
+        "make_lowp_allreduce_train_step is deprecated: the unified "
+        "make_sharded_train_step reads the grad wire dtype from the "
+        "sharding registry (hps.grad_allreduce_dtype) and supports "
+        "dp x tp meshes; call it directly",
+        DeprecationWarning, stacklevel=2)
     hps = plan.hps
-    if plan.tp > 1 or plan.sp > 1:
-        raise ValueError(
-            "grad_allreduce_dtype=bfloat16 supports pure-dp meshes only "
-            f"(tp=sp=1), got tp={plan.tp} sp={plan.sp}")
-    if not hps.pointer_gen:
-        raise ValueError(
-            "grad_allreduce_dtype=bfloat16 requires pointer_gen losses "
-            "(shard-mean == global-mean); the baseline CE normalizes by "
-            "the global token count")
-    from textsummarization_on_flink_tpu.train import optim
-
-    loss_fn = trainer_lib.make_loss_fn(hps)
-    inv_dp = 1.0 / plan.dp
-
-    def per_shard(state, arrays):
-        grads, out = jax.grad(
-            lambda p: loss_fn(p, arrays), has_aux=True)(state.params)
-        # THE lever: the dp all-reduce rides the wire in bf16 (half the
-        # bytes); f32 is restored before any update math touches it
-        grads = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g.astype(jnp.bfloat16), "dp")
-            .astype(jnp.float32) * inv_dp, grads)
-        grads, gnorm = optim.clip_by_global_norm(grads, hps.max_grad_norm)
-        new_params, new_opt = optim.adagrad_update(
-            grads, state.opt_state, state.params, hps.lr)
-        new_state = trainer_lib.TrainState(
-            params=new_params, opt_state=new_opt, step=state.step + 1)
-        metrics = trainer_lib.StepMetrics(
-            loss=jax.lax.pmean(out.loss, "dp"),
-            coverage_loss=jax.lax.pmean(out.coverage_loss, "dp"),
-            total_loss=jax.lax.pmean(out.total_loss, "dp"),
-            global_norm=gnorm)
-        return new_state, metrics
-
-    probe = state if state is not None else jax.eval_shape(
-        lambda: trainer_lib.init_train_state(hps, hps.vocab_size, seed=0))
-    state_specs = state_pspecs(probe)
-    batch_specs = {k: batch_pspec(k)
-                   for k in batch_sharding(plan)}
-    metric_specs = trainer_lib.StepMetrics(
-        loss=P(), coverage_loss=P(), total_loss=P(), global_norm=P())
-    from textsummarization_on_flink_tpu.parallel import ring_attention as ra
-
-    fn = ra.compat_shard_map(per_shard, plan.mesh,
-                             in_specs=(state_specs, batch_specs),
-                             out_specs=(state_specs, metric_specs))
-    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+    if getattr(hps, "grad_allreduce_dtype", "float32") != "bfloat16":
+        plan = dataclasses.replace(
+            plan, hps=hps.replace(grad_allreduce_dtype="bfloat16"))
+    return make_sharded_train_step(plan, donate=donate, state=state)
 
 
 def make_sharded_eval_step(plan: MeshPlan, params: Optional[PyTree] = None):
@@ -324,12 +304,11 @@ def make_sharded_eval_step(plan: MeshPlan, params: Optional[PyTree] = None):
     (e.g. a TF1-imported checkpoint) so in_shardings match, mirroring
     make_sharded_train_step's `state` parameter."""
     hps = plan.hps
+    reg = plan.registry
     eval_fn = _with_mesh_context(plan, trainer_lib.make_eval_step(hps))
     param_sh = param_shardings(plan, params)
-    batch_sh = batch_sharding(plan)
-    metric_sh = trainer_lib.StepMetrics(
-        loss=plan.named(P()), coverage_loss=plan.named(P()),
-        total_loss=plan.named(P()), global_norm=plan.named(P()))
+    batch_sh = reg.shardings(reg.batch_specs())
+    metric_sh = reg.shardings(reg.metric_specs())
     return jax.jit(eval_fn, in_shardings=(param_sh, batch_sh),
                    out_shardings=metric_sh)
 
@@ -381,21 +360,17 @@ def make_sharded_beam_search(plan: MeshPlan,
     chip-local, so there is zero cross-chip traffic during the decode
     loop — the ideal layout for throughput serving).
 
-    Returns a jitted fn(params, arrays) -> BeamSearchOutput.  Encoder
-    inputs shard over (dp[, sp]); params replicate/tp-shard as in
-    training.
+    Returns a jitted fn(params, arrays) -> BeamSearchOutput.  All
+    shardings come from the registry (enc batch, params, beam output).
     """
     from textsummarization_on_flink_tpu.decode import beam_search
 
     hps = plan.hps
+    reg = plan.registry
     param_sh = param_shardings(plan, params)
-    enc_names = ("enc_batch", "enc_lens", "enc_padding_mask",
-                 "enc_batch_extend_vocab")
-    batch_sh = {k: plan.named(batch_pspec(k)) for k in enc_names}
-    out_sh = beam_search.BeamSearchOutput(
-        tokens=plan.named(P("dp")), length=plan.named(P("dp")),
-        avg_log_prob=plan.named(P("dp")), attn_dists=plan.named(P("dp")),
-        p_gens=plan.named(P("dp")))
+    batch_sh = reg.shardings(
+        reg.batch_specs(sharding_lib.ENC_BATCH_NAMES))
+    out_sh = reg.shardings(reg.beam_output_specs())
 
     def search(p, arrays):
         return beam_search._search_batch(p, hps, arrays)
@@ -443,6 +418,6 @@ def global_batch_from_host_local(plan: MeshPlan,
     would silently interleave unrelated rows."""
     from jax.experimental import multihost_utils
 
-    pspecs = {k: batch_pspec(k) for k in arrays}
+    pspecs = plan.registry.batch_specs(tuple(arrays))
     return multihost_utils.host_local_array_to_global_array(
         arrays, plan.mesh, pspecs)

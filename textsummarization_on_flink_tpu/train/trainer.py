@@ -103,24 +103,45 @@ def make_loss_fn(hps: HParams):
     return loss_fn
 
 
-def make_train_step(hps: HParams) -> Callable[[TrainState, Dict[str, Array]],
-                                              Tuple[TrainState, StepMetrics]]:
-    """Build the pure train-step function (jit it, or pjit via parallel/)."""
-
+def make_grad_fn(hps: HParams) -> Callable:
+    """(params, arrays) -> (grads, (loss, coverage_loss, total_loss)) —
+    the default gradient computation: one jax.grad of the shared loss
+    objective, reductions left to XLA (under pjit the partitioner
+    inserts the dp gradient psum in the grads' own dtype).  The sharded
+    step builder (parallel/mesh.py) substitutes a registry-driven
+    variant when the grad wire dtype is annotated."""
     loss_fn_ = make_loss_fn(hps)
 
-    def train_step(state: TrainState, arrays: Dict[str, Array]):
-        def loss_fn(params):
-            return loss_fn_(params, arrays)
+    def grad_fn(params: PyTree, arrays: Dict[str, Array]):
+        grads, out = jax.grad(
+            lambda p: loss_fn_(p, arrays), has_aux=True)(params)
+        return grads, (out.loss, out.coverage_loss, out.total_loss)
 
-        grads, out = jax.grad(loss_fn, has_aux=True)(state.params)
+    return grad_fn
+
+
+def make_train_step(hps: HParams, grad_fn: Optional[Callable] = None,
+                    ) -> Callable[[TrainState, Dict[str, Array]],
+                                  Tuple[TrainState, StepMetrics]]:
+    """Build the pure train-step function (jit it, or pjit via parallel/).
+
+    The step BODY (clip -> Adagrad -> state/metrics) exists only here:
+    every path — single-device jit, the pjit mesh step, and the
+    bf16-wire collective variant — shares it and differs solely in the
+    `grad_fn` that produces (grads, scalar losses) (ISSUE 8: one jitted
+    step, layout and wire dtype decided by the sharding registry)."""
+
+    grad_fn_ = grad_fn if grad_fn is not None else make_grad_fn(hps)
+
+    def train_step(state: TrainState, arrays: Dict[str, Array]):
+        grads, (loss, cov_loss, total) = grad_fn_(state.params, arrays)
         grads, gnorm = optim.clip_by_global_norm(grads, hps.max_grad_norm)
         new_params, new_opt = optim.adagrad_update(
             grads, state.opt_state, state.params, hps.lr)
         new_state = TrainState(params=new_params, opt_state=new_opt,
                                step=state.step + 1)
-        metrics = StepMetrics(loss=out.loss, coverage_loss=out.coverage_loss,
-                              total_loss=out.total_loss, global_norm=gnorm)
+        metrics = StepMetrics(loss=loss, coverage_loss=cov_loss,
+                              total_loss=total, global_norm=gnorm)
         return new_state, metrics
 
     return train_step

@@ -54,6 +54,12 @@ DEFAULT: Dict[str, Any] = {
                 # trace-time side effect) here serializes every dispatch
                 r"^_make_beam_body",  # covers the <locals>.body closure
                 r"^_finalize_beam",  # covers the <locals>.back backtrack
+                # the unified sharded step builder (ISSUE 8): its traced
+                # closures (train_step body, the wire-dtype grad fn) run
+                # every optimizer step on every chip — a stray host sync
+                # or trace-time side effect here poisons the whole mesh
+                r"^make_sharded_train_step",
+                r"^_make_wire_grad_fn",
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
