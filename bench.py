@@ -1365,6 +1365,22 @@ def bench_serve() -> None:
             shed0 = reg.counter("serve/shed_total").value
             degraded0 = reg.counter("serve/degraded_total").value
             lat: list = []
+            # trace-derived per-request breakdown (ISSUE 9 satellite):
+            # TEE the timed phase's lifecycle events into memory (an
+            # installed EventSink keeps receiving everything — the
+            # capture must not eat the run's events.jsonl) and split
+            # every e2e latency into queue wait vs resident/decode
+            # time — row fields only, fingerprint-neutral
+            from textsummarization_on_flink_tpu.obs.export import MemorySink
+
+            prev_sink, trace_sink = reg.event_sink, MemorySink()
+
+            class _Tee:
+                def emit(self, rec):
+                    ok = trace_sink.emit(rec)
+                    if prev_sink is not None:
+                        ok = prev_sink.emit(rec) and ok
+                    return ok
 
             def one(i: int) -> None:
                 t0 = time.perf_counter()
@@ -1372,10 +1388,14 @@ def bench_serve() -> None:
                               block=True).result(timeout=1200)
                 lat.append(time.perf_counter() - t0)
 
-            t0 = time.perf_counter()
-            with ThreadPoolExecutor(max_workers=conc) as ex:
-                list(ex.map(one, range(reqs)))
-            wall = time.perf_counter() - t0
+            reg.event_sink = _Tee()
+            try:
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(max_workers=conc) as ex:
+                    list(ex.map(one, range(reqs)))
+                wall = time.perf_counter() - t0
+            finally:
+                reg.event_sink = prev_sink
         # continuous mode dispatches chunks, not micro-batches: report
         # the batch stats as zero rather than clamping to a fabricated
         # one-batch row
@@ -1397,6 +1417,23 @@ def bench_serve() -> None:
             xs = sorted(xs)
             return xs[min(len(xs) - 1, int(len(xs) * q))]
 
+        # per-uuid first-occurrence timestamps of each lifecycle stage
+        per_req: dict = {}
+        for ev in trace_sink.records():
+            if ev.get("kind") != "request":
+                continue
+            stages = per_req.setdefault(ev.get("uuid", ""), {})
+            stages.setdefault(ev.get("event"), ev.get("ts_us", 0))
+        queue_ms, resident_ms = [], []
+        for uuid, st in per_req.items():
+            if not uuid.startswith("r"):
+                continue  # timed requests only (warm-up is w/"warm*")
+            if "enqueue" in st and "admit" in st:
+                queue_ms.append((st["admit"] - st["enqueue"]) / 1e3)
+            end = st.get("finish", st.get("resolve"))
+            if "admit" in st and end is not None:
+                resident_ms.append((end - st["admit"]) / 1e3)
+
         _, info = _device_info()
         rec = {
             "metric": "serve_e2e_p50_latency_ms",
@@ -1416,6 +1453,19 @@ def bench_serve() -> None:
                 reg.counter("serve/deadline_evictions_total").value
                 - evict0),
             "requests_per_sec": round(reqs / wall, 2),
+            # the trace-derived split of the e2e latency above: where a
+            # request's time went (queue wait vs resident/decode) —
+            # mean + p99 over the timed requests, from the same
+            # lifecycle events scripts/trace_summary.py --request reads
+            "queue_ms_mean": round(sum(queue_ms) / len(queue_ms), 2)
+            if queue_ms else 0.0,
+            "queue_ms_p99": round(pct(queue_ms, 0.99), 2)
+            if queue_ms else 0.0,
+            "resident_ms_mean": round(sum(resident_ms) / len(resident_ms),
+                                      2) if resident_ms else 0.0,
+            "resident_ms_p99": round(pct(resident_ms, 0.99), 2)
+            if resident_ms else 0.0,
+            "traced_requests": len(queue_ms),
             "reqs": reqs,
             "concurrency": conc,
             "batch": batch,

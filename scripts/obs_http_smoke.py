@@ -1,0 +1,100 @@
+"""Live-plane smoke (ISSUE 9): start the obs HTTP exposition server,
+drive a short continuous-serve run against the real tiny model while
+scraping /metrics and /healthz, and assert the scrape is byte-identical
+to ``obs.render_text()`` once the run quiesces.  Also proves the
+request-trace path end to end: the run writes a unified events.jsonl
+and ``scripts/trace_summary.py --request`` reconstructs one uuid's
+timeline from it.  Wired into scripts/repro.sh.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from textsummarization_on_flink_tpu import obs  # noqa: E402
+from textsummarization_on_flink_tpu.config import HParams  # noqa: E402
+from textsummarization_on_flink_tpu.data.vocab import Vocab  # noqa: E402
+from textsummarization_on_flink_tpu.serve.server import (  # noqa: E402
+    ServingServer,
+)
+from textsummarization_on_flink_tpu.train import trainer  # noqa: E402
+
+
+def get(port: int, route: str):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{route}", timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def main() -> None:
+    vocab = Vocab(words=["article", "reference", ".", "0", "1", "2", "3",
+                         "4", "5", "6", "7"])
+    hps = HParams(mode="decode", batch_size=2, hidden_dim=16, emb_dim=8,
+                  vocab_size=vocab.size(), max_enc_steps=16, max_dec_steps=6,
+                  beam_size=2, min_dec_steps=1, max_oov_buckets=4,
+                  serve_mode="continuous", serve_slots=2,
+                  serve_refill_chunk=2, serve_max_queue=32)
+    params = trainer.init_train_state(hps, vocab.size(), seed=0).params
+
+    events_dir = tempfile.mkdtemp(prefix="obs_http_smoke_")
+    sink = obs.install_event_sink(events_dir, flush_secs=0.1)
+    srv = obs.serve_http(0)  # ephemeral localhost port
+    try:
+        server = ServingServer(
+            hps, vocab, params=params,
+            decode_root=tempfile.mkdtemp(prefix="obs_http_smoke_dec_"))
+        with server:
+            futs = [server.submit(f"article {i} .", uuid=f"uuid-{i}")
+                    for i in range(8)]
+            # scrape DURING the loaded run: both endpoints must answer
+            # while the dispatch thread is working
+            status, live_metrics = get(srv.port, "/metrics")
+            assert status == 200 and b"# TYPE" in live_metrics
+            status, health = get(srv.port, "/healthz")
+            payload = json.loads(health)
+            assert payload["status"] in ("ok", "degraded"), payload
+            assert "serve/dispatch" in payload["components"], payload
+            for f in futs:
+                f.result(timeout=600)
+        # quiesced: the scrape must be byte-identical to the in-process
+        # exposition (same counter set, same values)
+        status, body = get(srv.port, "/metrics")
+        assert status == 200
+        rendered = obs.render_text().encode("utf-8")
+        assert body == rendered, (
+            f"scrape ({len(body)}B) != render_text ({len(rendered)}B)")
+        status, health = get(srv.port, "/healthz")
+        payload = json.loads(health)
+        # the stopped server RETIRED its beat — a finished component
+        # must not pin /healthz at degraded
+        assert "serve/dispatch" not in payload["components"], payload
+        status, snap = get(srv.port, "/snapshot")
+        snapshot = json.loads(snap)
+        assert snapshot.get("serve/completed_total", {}).get("value") == 8.0
+    finally:
+        srv.close()
+        sink.close()
+
+    # one uuid's timeline back out of the unified events.jsonl
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "trace_summary.py"),
+         events_dir, "--request", "uuid-3", "--json"],
+        capture_output=True, text=True, check=True)
+    tl = json.loads(out.stdout)
+    stages = {e["event"] for e in tl["events"]}
+    assert {"enqueue", "admit", "slot", "finish", "resolve"} <= stages, stages
+    assert tl["phases"].get("total_ms") is not None, tl["phases"]
+    print(f"obs http smoke OK: scrape == render_text "
+          f"({len(body)} bytes), healthz {payload['status']} "
+          f"({', '.join(sorted(payload['components']))}), uuid-3 timeline "
+          f"{sorted(stages)} over {tl['phases']['total_ms']:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,12 @@ echo "== serve smoke (CollectionSource -> ServingServer -> CollectionSink)"
 # with row-for-row parity asserted between them
 python scripts/serve_smoke.py
 
+echo "== live-plane smoke (/metrics + /healthz scrape over a continuous run)"
+# the ISSUE-9 exposition plane end to end: scrape-vs-render_text byte
+# parity, healthz component heartbeats, and one uuid's trace timeline
+# reconstructed from the unified events.jsonl (trace_summary --request)
+python scripts/obs_http_smoke.py
+
 echo "== bench smokes (CPU, tiny): train / input / decode / serve"
 T="$(mktemp -d)"
 trap 'rm -rf "$T"' EXIT

@@ -4,6 +4,7 @@ arbitration asks for (top ops by device time, per lane).
 
     python scripts/trace_summary.py [exp/trace_r05] [--top 15] [--json]
     python scripts/trace_summary.py logs/exp/train/events.jsonl
+    python scripts/trace_summary.py exp/serve/events.jsonl --request u17
 
 Two capture kinds, one tool (ISSUE 1 satellite):
 
@@ -24,6 +25,13 @@ are XLA ops/fusions, which is the table that names the bottleneck op
 Directory arguments prefer profiler captures when both kinds are
 present (the established behavior); point at the events.jsonl file
 directly — or a directory holding only events.jsonl — for span tables.
+
+``--request <uuid>`` switches to the request-timeline view (ISSUE 9):
+the ``{"kind": "request"}`` lifecycle events the serve path emits
+(enqueue -> admit -> slot -> finish -> resolve, OBSERVABILITY.md
+"Request-scoped tracing") are reconstructed for one uuid, printed with
+per-phase durations (queue wait vs resident/decode vs resolve fan-out),
+plus any spans stamped with the request's trace_id.
 """
 
 from __future__ import annotations
@@ -144,6 +152,116 @@ def summarize(trace: dict, include_host_frames: bool = False) -> list:
     return out
 
 
+def _iter_jsonl(path: str):
+    """Parsed records of one events.jsonl (bad/half-written lines
+    skipped, same tolerance as _events_jsonl_to_trace)."""
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                yield rec
+
+
+def request_timeline(paths, uuid: str) -> dict:
+    """One request's reconstructed timeline from unified events.jsonl
+    file(s): its lifecycle events (by uuid), the spans sharing its
+    trace_id, and the per-phase durations.
+
+    Returns {"uuid", "trace_id", "events": [...], "spans": [...],
+    "phases": {...}} — events/spans sorted by ts_us.  Phases (ms):
+    ``queue`` = enqueue->admit, ``resident`` = admit->finish (or
+    ->resolve when no finish event exists, e.g. a queue eviction),
+    ``resolve`` = finish->resolve, ``total`` = enqueue->resolve.
+    """
+    # pass 1: the uuid's request events (tiny result set).  Buffering
+    # the file's spans instead would hold memory proportional to the
+    # whole capture just to answer one uuid.
+    events: list = []
+    for path in paths:
+        events.extend(r for r in _iter_jsonl(path)
+                      if r.get("kind") == "request"
+                      and r.get("uuid") == uuid)
+    events.sort(key=lambda r: r.get("ts_us", 0))
+    trace_ids = {r["trace_id"] for r in events if r.get("trace_id")}
+    trace_id = sorted(trace_ids)[0] if trace_ids else None
+    # pass 2 (only when the uuid matched a trace): spans sharing its
+    # trace_ids.  A cheap substring pre-filter skips the JSON decode
+    # for the vast majority of non-matching lines, so the second pass
+    # costs ~one scan, with memory bounded by the MATCHING spans.
+    spans: list = []
+    if trace_ids:
+        for path in paths:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    if '"span"' not in line or not any(
+                            tid in line for tid in trace_ids):
+                        continue
+                    try:
+                        r = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (isinstance(r, dict) and r.get("kind") == "span"
+                            and r.get("trace_id") in trace_ids):
+                        spans.append(r)
+        spans.sort(key=lambda r: r.get("ts_us", 0))
+    first = {}
+    for r in events:  # first occurrence of each lifecycle stage wins
+        first.setdefault(r.get("event"), r.get("ts_us", 0))
+    phases = {}
+
+    def _ms(a, b):
+        return round((first[b] - first[a]) / 1e3, 3)
+
+    if "enqueue" in first and "admit" in first:
+        phases["queue_ms"] = _ms("enqueue", "admit")
+    if "admit" in first:
+        if "finish" in first:
+            phases["resident_ms"] = _ms("admit", "finish")
+        elif "resolve" in first:
+            phases["resident_ms"] = _ms("admit", "resolve")
+    if "finish" in first and "resolve" in first:
+        phases["resolve_ms"] = _ms("finish", "resolve")
+    if "enqueue" in first and "resolve" in first:
+        phases["total_ms"] = _ms("enqueue", "resolve")
+    return {"uuid": uuid, "trace_id": trace_id, "events": events,
+            "spans": spans, "phases": phases,
+            "trace_ids": sorted(trace_ids)}
+
+
+def print_request_timeline(tl: dict) -> int:
+    if not tl["events"]:
+        print(f"no request events for uuid {tl['uuid']!r} — was the run "
+              f"writing a unified events.jsonl (obs.install_event_sink / "
+              f"TS_OBS_EVENTS=1, OBSERVABILITY.md)?", file=sys.stderr)
+        return 1
+    print(f"request {tl['uuid']!r} (trace {tl['trace_id']}):")
+    t0 = tl["events"][0].get("ts_us", 0)
+    for r in tl["events"]:
+        attrs = r.get("attrs") or {}
+        extra = (" (" + ", ".join(f"{k}={v}" for k, v in attrs.items())
+                 + ")") if attrs else ""
+        print(f"  +{(r.get('ts_us', 0) - t0) / 1e3:>9.3f} ms "
+              f"{r.get('event')}{extra}")
+    if tl["phases"]:
+        print("phases: " + " | ".join(
+            f"{k[:-3]} {v:.3f} ms" for k, v in tl["phases"].items()))
+    if tl["spans"]:
+        print(f"spans in trace ({len(tl['spans'])}):")
+        for s in tl["spans"]:
+            print(f"  +{(s.get('ts_us', 0) - t0) / 1e3:>9.3f} ms "
+                  f"{s.get('name')} ({s.get('dur_us', 0) / 1e3:.3f} ms)")
+    if len(tl["trace_ids"]) > 1:
+        print(f"WARNING: uuid maps to {len(tl['trace_ids'])} trace_ids "
+              f"(resubmitted uuid?): {tl['trace_ids']}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("trace_dir", nargs="?", default="exp/trace_r05")
@@ -151,7 +269,31 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--host-frames", action="store_true",
                     help="keep $file:line python-frame events")
+    ap.add_argument("--request", metavar="UUID", default=None,
+                    help="reconstruct ONE request's lifecycle timeline "
+                         "(enqueue->admit->slot->finish->resolve) from "
+                         "unified events.jsonl instead of the op table")
     args = ap.parse_args(argv)
+
+    if args.request is not None:
+        jsonl = [p for p in find_trace_files(args.trace_dir)
+                 if p.endswith(".jsonl")]
+        if not jsonl:
+            # a directory holding profiler captures only: look for the
+            # events.jsonl family explicitly (request events live there)
+            jsonl = sorted(glob.glob(
+                os.path.join(args.trace_dir, "**", "events.jsonl"),
+                recursive=True)) if os.path.isdir(args.trace_dir) else []
+        if not jsonl:
+            print(f"no events.jsonl under {args.trace_dir} — request "
+                  f"timelines need the unified event stream "
+                  f"(OBSERVABILITY.md)", file=sys.stderr)
+            return 1
+        tl = request_timeline(jsonl, args.request)
+        if args.json:
+            print(json.dumps(tl))
+            return 0 if tl["events"] else 1
+        return print_request_timeline(tl)
 
     files = find_trace_files(args.trace_dir)
     if not files:

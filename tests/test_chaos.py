@@ -12,6 +12,7 @@ top); the whole file is also part of the default suite — every test is
 deterministic and CPU-fast.
 """
 
+import json
 import socketserver
 import threading
 import time
@@ -761,3 +762,120 @@ class TestServeChaos:
         assert reg.counter("serve/errors_total").value == 1
         assert reg.counter("serve/completed_total").value == 1
         assert reg.counter("resilience/fault/serve.dispatch").value == 1
+
+
+# -- flight recorder: dumps under injected faults (ISSUE 9) ----------------
+
+class TestFlightRecorderForensics:
+    """Acceptance: under injected ``train.step_nan`` and
+    ``serve.dispatch`` faults (the existing TS_FAULTS points), a
+    ``flight_<reason>.jsonl`` dump exists holding >= the configured ring
+    of frames recorded strictly before the trigger fired."""
+
+    # p=0.35 with seed 5 first fires on the 7th fire() call — verified
+    # below against the same RNG the fault plan uses, so the ring (4)
+    # is guaranteed full of pre-trigger frames
+    FAULT_PROB, FAULT_SEED, FIRST_FIRE = 0.35, 5, 7
+
+    def test_seed_fires_on_seventh_call(self):
+        import random
+
+        rng = random.Random(self.FAULT_SEED)
+        first = next(i for i in range(1, 100)
+                     if rng.random() < self.FAULT_PROB)
+        assert first == self.FIRST_FIRE
+
+    def test_injected_train_nan_dumps_preceding_steps(self, tmp_path):
+        """Six clean steps flush six frames; the injected NaN at step 6
+        dumps the newest 4 of them to the train dir."""
+        hps = hps_tiny(
+            log_root=str(tmp_path), exp_name="t", metrics_every=1,
+            flight_frames=4,
+            faults=f"train.step_nan:{self.FAULT_PROB}:{self.FAULT_SEED}:1")
+        vocab = Vocab(words=["a", "b", "c", "d", "e", "f", "."])
+        batch = make_batch(hps, vocab)
+        trainer = trainer_lib.Trainer(hps, vocab.size(),
+                                      FixedBatcher(batch, 20))
+        with pytest.raises(trainer_lib.NonFiniteLossError, match="injected"):
+            trainer.train(num_steps=12)
+        dump = tmp_path / "t" / "train" / "flight_train_nan.jsonl"
+        assert dump.exists()
+        lines = [json.loads(ln) for ln in open(dump, encoding="utf-8")]
+        header, frames = lines[0], lines[1:]
+        assert header["kind"] == "flight" and header["reason"] == "train_nan"
+        assert header["context"] == {"step": 6, "injected": True}
+        # >= the configured ring, every frame STRICTLY before the trigger
+        assert len(frames) == 4 == header["capacity"]
+        assert [f["step"] for f in frames] == [2, 3, 4, 5]
+        assert all(f["kind"] == "train_step" and "loss" in f
+                   and "global_norm" in f and "step_time" in f
+                   and "prefetch_depth" in f for f in frames)
+
+    def test_injected_dispatch_fault_dumps_preceding_ticks(
+            self, tmp_path, _isolated_obs_and_faults):
+        """Continuous mode: six clean chunk ticks frame the ring; the
+        injected serve.dispatch failure on the 7th busy tick dumps them
+        (each busy tick frames BEFORE its dispatch, so the failing
+        tick's own pre-failure frame is included)."""
+        from textsummarization_on_flink_tpu.decode.decoder import (
+            DecodedResult,
+        )
+        from textsummarization_on_flink_tpu.serve.server import ServingServer
+
+        reg = _isolated_obs_and_faults
+        vocab = Vocab(words=["the", "cat", "sat", "."])
+        hps = HParams(
+            mode="decode", batch_size=2, max_enc_steps=8, max_dec_steps=4,
+            min_dec_steps=1, serve_max_queue=8, serve_mode="continuous",
+            serve_slots=2, serve_refill_chunk=2,
+            log_root=str(tmp_path), exp_name="s", flight_frames=4,
+            faults=f"serve.dispatch:{self.FAULT_PROB}:{self.FAULT_SEED}:1")
+
+        class NeverFinishEngine:
+            """One resident request, resident forever: every tick is a
+            busy tick, so fire() call N == busy tick N."""
+
+            slots = 2
+
+            def __init__(self):
+                self.packed = {}
+
+            def pack(self, idx, example):
+                self.packed[idx] = example
+
+            def step(self):
+                return []
+
+            def unpack(self, idx, example):  # pragma: no cover
+                return DecodedResult(uuid=example.uuid, article="",
+                                     decoded_words=[], reference="",
+                                     abstract_sents=[])
+
+            def release(self, idx):
+                self.packed.pop(idx, None)
+
+        class StubDec:
+            def maybe_reload_checkpoint(self, last):
+                return last
+
+        server = ServingServer(hps, vocab, decoder=StubDec(),
+                               engine=NeverFinishEngine(), registry=reg)
+        with server:
+            fut = server.submit("the cat sat", uuid="u0")
+            with pytest.raises(RuntimeError, match="injected serve.dispatch"):
+                fut.result(timeout=60)
+        dump = tmp_path / "s" / "flight_serve_dispatch.jsonl"
+        assert dump.exists()
+        lines = [json.loads(ln) for ln in open(dump, encoding="utf-8")]
+        header, frames = lines[0], lines[1:]
+        assert header["reason"] == "serve_dispatch"
+        assert header["context"] == {"error": "RuntimeError"}
+        # the full configured ring, recorded strictly before the trigger
+        assert len(frames) == 4 == header["capacity"]
+        assert all(f["kind"] == "serve_tick" for f in frames)
+        ticks = [f["tick"] for f in frames]
+        assert ticks == sorted(ticks)
+        assert ticks == list(range(ticks[0], ticks[0] + 4))  # consecutive
+        assert all(f["occupancy"] == 0.5 and f["refills"] in (0, 1)
+                   for f in frames)
+        assert server._faults.stats()["serve.dispatch"]["fires"] == 1

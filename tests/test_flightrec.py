@@ -1,0 +1,247 @@
+"""obs/flightrec.py: the failure flight recorder (ISSUE 9 tentpole,
+piece 3) — ring semantics, dump contract, and the non-chaos trigger
+sites (breaker open, eviction storm).  The TS_FAULTS-driven train/serve
+dump acceptance lives in tests/test_chaos.py."""
+
+import json
+import time
+
+import pytest
+
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.obs import flightrec
+from textsummarization_on_flink_tpu.obs.registry import Registry
+from textsummarization_on_flink_tpu.resilience.policy import (
+    CircuitBreaker,
+    Deadline,
+)
+
+
+def _read(path):
+    return [json.loads(ln) for ln in open(path, encoding="utf-8")]
+
+
+class TestFlightRecorder:
+    def test_ring_keeps_newest_capacity_frames(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), capacity=4,
+                                       registry=Registry())
+        for i in range(10):
+            rec.record("train_step", step=i)
+        frames = rec.frames()
+        assert [f["step"] for f in frames] == [6, 7, 8, 9]
+        # seq is global and monotonic; ts_us is stamped
+        assert [f["seq"] for f in frames] == [7, 8, 9, 10]
+        assert all(f["ts_us"] > 0 and f["kind"] == "train_step"
+                   for f in frames)
+
+    def test_dump_header_plus_frames(self, tmp_path):
+        reg = Registry()
+        rec = flightrec.FlightRecorder(str(tmp_path), capacity=3,
+                                       registry=reg)
+        for i in range(5):
+            rec.record("serve_tick", tick=i)
+        path = rec.dump("serve_dispatch", error="RuntimeError")
+        assert path.endswith("flight_serve_dispatch.jsonl")
+        lines = _read(path)
+        assert lines[0]["kind"] == "flight"
+        assert lines[0]["reason"] == "serve_dispatch"
+        assert lines[0]["frames"] == 3 and lines[0]["capacity"] == 3
+        assert lines[0]["context"] == {"error": "RuntimeError"}
+        assert [f["tick"] for f in lines[1:]] == [2, 3, 4]
+        assert reg.counter("obs/flight_dumps_total").value == 1
+
+    def test_repeat_dumps_suffixed_and_budgeted(self, tmp_path):
+        reg = Registry()
+        rec = flightrec.FlightRecorder(str(tmp_path), capacity=2,
+                                       registry=reg,
+                                       max_dumps_per_reason=2)
+        rec.record("serve_tick", tick=1)
+        p1 = rec.dump("breaker_x_open")
+        p2 = rec.dump("breaker_x_open")
+        p3 = rec.dump("breaker_x_open")  # over budget: dropped
+        assert p1.endswith("flight_breaker_x_open.jsonl")
+        assert p2.endswith("flight_breaker_x_open-2.jsonl")
+        assert p3 is None
+        assert reg.counter("obs/flight_dumps_total").value == 2
+        assert reg.counter("obs/flight_dumps_dropped_total").value == 1
+
+    def test_reason_sanitized_for_filenames(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), registry=Registry())
+        path = rec.dump("breaker serve.admission/open!")
+        assert path.endswith("flight_breaker_serve.admission_open_.jsonl")
+
+    def test_dump_failure_counted_not_raised(self, tmp_path):
+        reg = Registry()
+        target = tmp_path / "blocked"
+        target.write_text("a file where the directory should go")
+        rec = flightrec.FlightRecorder(str(target), registry=reg)
+        rec.record("train_step", step=1)
+        assert rec.dump("train_nan") is None
+        assert reg.counter("obs/flight_dump_errors_total").value == 1
+
+    def test_install_first_wins_and_module_helpers(self, tmp_path):
+        reg = Registry()
+        # unarmed: record/trigger are no-ops
+        flightrec.record(reg, "train_step", step=1)
+        assert flightrec.trigger(reg, "train_nan") is None
+        r1 = flightrec.install_flight_recorder(reg, str(tmp_path),
+                                               capacity=8)
+        r2 = flightrec.install_flight_recorder(reg, str(tmp_path / "b"))
+        assert r1 is r2 and reg.flight is r1
+        flightrec.record(reg, "train_step", step=2)
+        path = flightrec.trigger(reg, "train_nan", step=3)
+        lines = _read(path)
+        assert lines[0]["context"] == {"step": 3}
+        assert [f["step"] for f in lines[1:]] == [2]
+        # disabled registry: no install
+        assert flightrec.install_flight_recorder(
+            Registry(enabled=False), str(tmp_path)) is None
+
+    def test_capacity_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="capacity"):
+            flightrec.FlightRecorder(str(tmp_path), capacity=0)
+
+
+class TestTriggerSites:
+    def test_breaker_open_dumps(self, tmp_path):
+        """Every breaker-open transition (CLOSED->OPEN and the failed
+        HALF_OPEN probe) triggers a flight dump on the breaker's own
+        registry."""
+        reg = Registry()
+        flightrec.install_flight_recorder(reg, str(tmp_path), capacity=4)
+        flightrec.record(reg, "serve_tick", tick=1)
+        clock = [0.0]
+        br = CircuitBreaker(threshold=2, reset_secs=5.0, name="adm",
+                            clock=lambda: clock[0], registry=reg)
+        br.record_failure()
+        assert not (tmp_path / "flight_breaker_adm_open.jsonl").exists()
+        br.record_failure()  # trips
+        p1 = tmp_path / "flight_breaker_adm_open.jsonl"
+        assert p1.exists()
+        assert [f["tick"] for f in _read(p1)[1:]] == [1]
+        # half-open probe failure re-opens -> second (suffixed) dump
+        clock[0] += 10.0
+        assert br.allow()  # the half-open probe
+        br.record_failure()
+        assert (tmp_path / "flight_breaker_adm_open-2.jsonl").exists()
+
+    def test_eviction_storm_dumps(self, tmp_path):
+        """Half the slots evicted at one chunk boundary = a storm: the
+        ContinuousBatcher leaves the preceding ticks behind."""
+        from textsummarization_on_flink_tpu.serve.batcher import (
+            ContinuousBatcher,
+        )
+        from textsummarization_on_flink_tpu.serve.queue import (
+            RequestQueue,
+            ServeRequest,
+        )
+
+        class _Engine:
+            slots = 4
+
+            def release(self, idx):
+                pass
+
+        reg = Registry()
+        with obs.use_registry(reg):
+            flightrec.install_flight_recorder(reg, str(tmp_path),
+                                              capacity=8)
+            hps = HParams(batch_size=4)
+            q = RequestQueue(8, registry=reg)
+            cb = ContinuousBatcher(hps, q, _Engine(), registry=reg)
+            for i in range(4):
+                flightrec.record(reg, "serve_tick", tick=i)
+            # white-box: park 2 already-expired residents (no sleeps)
+            expired = Deadline(time.monotonic() - 1.0)
+            for idx in (0, 2):
+                req = ServeRequest(f"u{idx}", "a", "", example=None,
+                                   deadline=expired, registry=reg)
+                cb._resident[idx] = req
+            cb._evict_expired()
+        dump = tmp_path / "flight_eviction_storm.jsonl"
+        assert dump.exists()
+        lines = _read(dump)
+        assert lines[0]["context"]["evicted"] == 2
+        assert [f["tick"] for f in lines[1:]] == [0, 1, 2, 3]
+        assert reg.counter("serve/deadline_evictions_total").value == 2
+        # single evictions do NOT storm-trigger
+        req = ServeRequest("u9", "a", "", example=None, deadline=expired,
+                           registry=reg)
+        cb._resident[1] = req
+        with obs.use_registry(reg):
+            cb._evict_expired()
+        assert not (tmp_path / "flight_eviction_storm-2.jsonl").exists()
+
+
+class TestReviewFixes:
+    def test_nan_frames_dump_as_strict_json(self, tmp_path):
+        """The train_nan dump's whole point is the non-finite loss frame
+        — it must still be STRICT JSON (no bare NaN tokens that jq /
+        JSON.parse reject)."""
+        rec = flightrec.FlightRecorder(str(tmp_path), registry=Registry())
+        rec.record("train_step", step=1, loss=float("nan"),
+                   global_norm=float("inf"))
+        path = rec.dump("train_nan", step=1)
+        raw = open(path, encoding="utf-8").read()
+        assert "NaN" not in raw and "Infinity" not in raw
+        lines = _read(path)
+        assert lines[1]["loss"] == "nan"
+        assert lines[1]["global_norm"] == "inf"
+
+    def test_facade_capacity_zero_means_disabled(self, tmp_path):
+        reg = Registry()
+        assert obs.install_flight_recorder(str(tmp_path), capacity=0,
+                                           reg=reg) is None
+        assert reg.flight is None
+        rec = obs.install_flight_recorder(str(tmp_path), reg=reg)
+        assert rec is not None
+        assert rec.capacity == flightrec.DEFAULT_CAPACITY
+
+
+class TestHeartbeatRetire:
+    def test_finished_component_does_not_pin_healthz(self):
+        from textsummarization_on_flink_tpu.obs import http as obs_http
+
+        reg = Registry()
+        clock = [0.0]
+        board = obs_http.board_for(reg)
+        board._clock = lambda: clock[0]
+        board.beat("train/loop", period=1.0)
+        clock[0] += 100.0  # way past stale
+        assert obs_http.health(reg)["status"] == "degraded"
+        obs_http.retire_heartbeat(reg, "train/loop")
+        payload = obs_http.health(reg)
+        assert payload["status"] == "ok"
+        assert "train/loop" not in payload["components"]
+        # retiring the never-registered / disabled cases is a no-op
+        obs_http.retire_heartbeat(reg, "nope")
+        obs_http.retire_heartbeat(Registry(enabled=False), "train/loop")
+
+    def test_failed_writes_do_not_burn_the_dump_budget(self, tmp_path):
+        """A transiently unwritable directory must not consume the
+        per-reason allowance: when the disk recovers, the post-mortem
+        still gets written (and never overwrites an earlier success)."""
+        import os
+        import shutil
+
+        reg = Registry()
+        target = tmp_path / "blocked"
+        target.write_text("a file where the directory should go")
+        rec = flightrec.FlightRecorder(str(target), registry=reg,
+                                       max_dumps_per_reason=2)
+        rec.record("train_step", step=1)
+        for _ in range(3):  # three failed attempts
+            assert rec.dump("train_nan") is None
+        assert reg.counter("obs/flight_dump_errors_total").value == 3
+        assert reg.counter("obs/flight_dumps_dropped_total").value == 0
+        os.remove(target)  # the disk recovers
+        p = rec.dump("train_nan")
+        assert p is not None and os.path.exists(p)
+        # attempts drove the NAME (monotonic), successes the budget
+        assert p.endswith("flight_train_nan-4.jsonl")
+        p2 = rec.dump("train_nan")
+        assert p2 is not None  # budget of 2 successes, only 1 spent
+        assert rec.dump("train_nan") is None  # now genuinely spent
+        assert reg.counter("obs/flight_dumps_dropped_total").value == 1
+        shutil.rmtree(target, ignore_errors=True)

@@ -370,3 +370,144 @@ class TestTraceSummaryRoundTrip:
         assert trace_summary.find_trace_files(str(p)) == [str(p)]
         trace = trace_summary.load_events(str(p))
         assert len(trace["traceEvents"]) == 1  # bad tail line skipped
+
+
+# --------------------------------------------------------------------------
+# request-scoped tracing (ISSUE 9 tentpole)
+# --------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_new_and_child_linkage(self):
+        from textsummarization_on_flink_tpu.obs.spans import TraceContext
+
+        root = TraceContext.new()
+        child = root.child()
+        grand = child.child()
+        assert child.trace_id == root.trace_id == grand.trace_id
+        assert child.parent_id == root.span_id
+        assert grand.parent_id == child.span_id
+        # ids are unique per node
+        assert len({root.span_id, child.span_id, grand.span_id}) == 3
+        d = child.as_dict()
+        assert d == {"trace_id": root.trace_id, "span_id": child.span_id,
+                     "parent_id": root.span_id}
+        assert "parent_id" not in root.as_dict()
+
+    def test_explicit_parent_links_across_threads(self):
+        """The load-bearing property: a span opened on ANOTHER thread
+        with parent=ctx joins the trace the submit thread minted —
+        exactly what the thread-local stack cannot do."""
+        from textsummarization_on_flink_tpu.obs import spans as spans_lib
+
+        reg = Registry()
+        ctx = spans_lib.TraceContext.new()
+
+        def dispatch_thread():
+            with spans_lib.span(reg, "serve/dispatch", parent=ctx, fill=2):
+                with spans_lib.span(reg, "decode/slot_chunk"):
+                    pass
+
+        t = threading.Thread(target=dispatch_thread)
+        t.start()
+        t.join()
+        chunk, dispatch = spans_lib.tracer_for(reg).finished()
+        assert dispatch.name == "serve/dispatch"
+        assert dispatch.trace_id == ctx.trace_id
+        assert dispatch.parent_id == ctx.span_id
+        # the nested span INHERITS the trace through the stack
+        assert chunk.trace_id == ctx.trace_id
+        assert chunk.parent_id == dispatch.span_id
+
+    def test_untraced_spans_stay_unstamped(self):
+        from textsummarization_on_flink_tpu.obs import spans as spans_lib
+
+        reg = Registry()
+        with spans_lib.span(reg, "plain"):
+            pass
+        (rec,) = spans_lib.tracer_for(reg).finished()
+        assert rec.trace_id is None and rec.span_id is None
+        ev = rec.as_event()
+        assert "trace_id" not in ev and "span_id" not in ev
+        assert "trace_id" not in rec.as_chrome_event().get("args", {})
+
+    def test_ids_stamped_into_both_export_shapes(self):
+        from textsummarization_on_flink_tpu.obs import spans as spans_lib
+
+        reg = Registry()
+        ctx = spans_lib.TraceContext.new()
+        with spans_lib.span(reg, "serve/dispatch", parent=ctx):
+            pass
+        (rec,) = spans_lib.tracer_for(reg).finished()
+        ev = rec.as_event()
+        assert ev["trace_id"] == ctx.trace_id
+        assert ev["parent_id"] == ctx.span_id
+        assert ev["span_id"] == rec.span_id
+        args = rec.as_chrome_event()["args"]
+        assert args["trace_id"] == ctx.trace_id
+        assert args["parent_id"] == ctx.span_id
+
+    def test_request_event_round_trip(self):
+        from textsummarization_on_flink_tpu.obs import spans as spans_lib
+        from textsummarization_on_flink_tpu.obs.export import MemorySink
+
+        reg = Registry()
+        ctx = spans_lib.TraceContext.new()
+        # no sink installed: quietly refused
+        assert not spans_lib.request_event(reg, "enqueue", ctx, "u1")
+        sink = MemorySink()
+        reg.event_sink = sink
+        assert spans_lib.request_event(reg, "enqueue", ctx, "u1", depth=3)
+        assert spans_lib.request_event(reg, "resolve", ctx, "u1")
+        enq, res = sink.records()
+        assert enq["kind"] == "request" and enq["event"] == "enqueue"
+        assert enq["uuid"] == "u1" and enq["attrs"] == {"depth": 3}
+        assert enq["trace_id"] == res["trace_id"] == ctx.trace_id
+        assert enq["span_id"] == ctx.span_id
+        assert res["ts_us"] >= enq["ts_us"] > 0
+        # disabled registry: no-op
+        assert not spans_lib.request_event(
+            Registry(enabled=False), "enqueue", ctx, "u1")
+
+
+class TestEventSinkGapAnnotation:
+    def test_drop_episode_leaves_marker_in_stream(self, tmp_path):
+        """ISSUE 9 satellite: after drops, the NEXT flushed batch carries
+        one {"kind": "drops", "count": N} record — the hole is visible in
+        events.jsonl itself, not only in obs/events_dropped_total."""
+        from textsummarization_on_flink_tpu.obs.export import EventSink
+
+        reg = Registry()
+        # flusher parks for 100s unless kicked: overfill deterministically
+        sink = EventSink(str(tmp_path), flush_secs=100.0, max_queue=1,
+                         registry=reg)
+        assert sink.emit({"kind": "span", "name": "kept"})
+        assert not sink.emit({"kind": "span", "name": "lost1"})
+        assert not sink.emit({"kind": "span", "name": "lost2"})
+        sink.close()
+        recs = [json.loads(ln)
+                for ln in open(tmp_path / "events.jsonl", encoding="utf-8")]
+        assert [r["kind"] for r in recs] == ["span", "drops"]
+        assert recs[1]["count"] == 2
+        assert recs[1]["ts_us"] > 0
+        assert reg.counter("obs/events_dropped_total").value == 2
+
+    def test_no_drops_no_marker(self, tmp_path):
+        from textsummarization_on_flink_tpu.obs.export import EventSink
+
+        reg = Registry()
+        sink = EventSink(str(tmp_path), flush_secs=0.05, registry=reg)
+        sink.emit({"kind": "span", "name": "a"})
+        sink.close()
+        recs = [json.loads(ln)
+                for ln in open(tmp_path / "events.jsonl", encoding="utf-8")]
+        assert [r["kind"] for r in recs] == ["span"]
+
+
+class TestMemorySink:
+    def test_emit_and_bound(self):
+        from textsummarization_on_flink_tpu.obs.export import MemorySink
+
+        s = MemorySink(max_records=2)
+        assert s.emit({"a": 1}) and s.emit({"a": 2})
+        assert not s.emit({"a": 3})
+        assert [r["a"] for r in s.records()] == [1, 2]

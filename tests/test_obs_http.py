@@ -1,0 +1,229 @@
+"""obs/http.py: the live exposition plane (ISSUE 9 tentpole, piece 2).
+
+Acceptance-critical properties: a /metrics scrape byte-parses as the
+SAME counter set as ``registry.render_text()``, and /healthz flips to
+degraded when a registered heartbeat goes stale — simulated through the
+injectable monotonic clock, never with sleeps.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.obs import http as obs_http
+from textsummarization_on_flink_tpu.obs import spans as spans_lib
+from textsummarization_on_flink_tpu.obs.registry import Registry
+from textsummarization_on_flink_tpu.resilience.policy import CircuitBreaker
+
+
+def _get(port, route):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{route}", timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _parse_metrics(text):
+    """Prometheus text -> {name: value} for counters/gauges plus the
+    set of TYPE declarations (histogram series collapse to their name)."""
+    values, types = {}, {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            types[name] = kind
+        elif line and not line.startswith("#"):
+            name, _, val = line.rpartition(" ")
+            base = name.split("{", 1)[0]
+            values[base] = float(val)
+    return types, values
+
+
+@pytest.fixture
+def served():
+    reg = Registry()
+    srv = obs_http.ObsHttpServer(reg, port=0).start()
+    try:
+        yield reg, srv
+    finally:
+        srv.close()
+
+
+class TestEndpoints:
+    def test_metrics_scrape_matches_render_text(self, served):
+        reg, srv = served
+        reg.counter("serve/completed_total").inc(5)
+        reg.gauge("serve/queue_depth").set(2)
+        reg.histogram("serve/e2e_latency_seconds",
+                      buckets=(0.1, 1.0)).observe(0.05)
+        status, body = _get(srv.port, "/metrics")
+        assert status == 200
+        rendered = reg.render_text()
+        assert body.decode("utf-8") == rendered
+        # and the scrape byte-parses as the same counter set
+        t_scrape, v_scrape = _parse_metrics(body.decode("utf-8"))
+        t_local, v_local = _parse_metrics(rendered)
+        assert t_scrape == t_local and v_scrape == v_local
+        assert t_scrape["serve_completed_total"] == "counter"
+        assert v_scrape["serve_completed_total"] == 5.0
+
+    def test_snapshot_json(self, served):
+        reg, srv = served
+        reg.counter("train/steps_total").inc(7)
+        status, body = _get(srv.port, "/snapshot")
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["train/steps_total"]["value"] == 7.0
+
+    def test_spans_json_with_trace_ids(self, served):
+        reg, srv = served
+        ctx = spans_lib.TraceContext.new()
+        with spans_lib.span(reg, "serve/dispatch", parent=ctx, fill=1):
+            pass
+        status, body = _get(srv.port, "/spans")
+        assert status == 200
+        (rec,) = json.loads(body)
+        assert rec["name"] == "serve/dispatch"
+        assert rec["trace_id"] == ctx.trace_id
+
+    def test_spans_n_limits(self, served):
+        reg, srv = served
+        for i in range(5):
+            with spans_lib.span(reg, f"s{i}"):
+                pass
+        status, body = _get(srv.port, "/spans?n=2")
+        assert [r["name"] for r in json.loads(body)] == ["s3", "s4"]
+
+    def test_unknown_route_404(self, served):
+        _, srv = served
+        status, body = _get(srv.port, "/nope")
+        assert status == 404
+        assert "/metrics" in json.loads(body)["routes"]
+
+    def test_concurrent_scrapes_consistent(self, served):
+        """A loaded plane: writers mutating metrics while scrapers pull
+        — every response parses; no torn exposition."""
+        reg, srv = served
+        stop = threading.Event()
+
+        def writer():
+            c = reg.counter("serve/completed_total")
+            while not stop.is_set():
+                c.inc()
+
+        bodies = []
+
+        def scraper():
+            for _ in range(10):
+                status, body = _get(srv.port, "/metrics")
+                assert status == 200
+                bodies.append(body)
+
+        w = threading.Thread(target=writer)
+        scrapers = [threading.Thread(target=scraper) for _ in range(3)]
+        w.start()
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join()
+        stop.set()
+        w.join()
+        for body in bodies:
+            types, values = _parse_metrics(body.decode("utf-8"))
+            assert types.get("serve_completed_total") == "counter"
+            assert values["serve_completed_total"] >= 0
+
+
+class TestHealthz:
+    def test_ok_then_degraded_on_stale_heartbeat_no_sleeps(self, served):
+        reg, srv = served
+        clock = [100.0]
+        board = obs_http.board_for(reg)
+        board._clock = lambda: clock[0]
+        board.beat("serve/dispatch", period=1.0)
+        status, body = _get(srv.port, "/healthz")
+        payload = json.loads(body)
+        assert status == 200 and payload["status"] == "ok"
+        assert payload["components"]["serve/dispatch"]["ok"]
+        # time passes (simulated): 3x the period + epsilon -> stale
+        clock[0] += 3.5
+        status, body = _get(srv.port, "/healthz")
+        payload = json.loads(body)
+        assert status == 503 and payload["status"] == "degraded"
+        assert not payload["components"]["serve/dispatch"]["ok"]
+        assert payload["components"]["serve/dispatch"]["age_seconds"] == 3.5
+        # a fresh beat recovers it
+        board.beat("serve/dispatch", period=1.0)
+        status, body = _get(srv.port, "/healthz")
+        assert status == 200
+
+    def test_open_breaker_reported_but_informational(self, served):
+        """An OPEN breaker is visible on /healthz but must NOT 503 it:
+        503-ing an open ADMISSION breaker drains the instance, which
+        starves the half-open probe, which pins the breaker open — a
+        self-sustaining trap.  Degradation is heartbeat-staleness only
+        (the ISSUE-9 contract)."""
+        reg, srv = served
+        br = CircuitBreaker(threshold=1, reset_secs=1e9,
+                            name="serve.admission", registry=reg)
+        status, body = _get(srv.port, "/healthz")
+        assert json.loads(body)["breakers"] == {"serve.admission": "closed"}
+        br.record_failure()
+        status, body = _get(srv.port, "/healthz")
+        payload = json.loads(body)
+        assert status == 200 and payload["status"] == "ok"
+        assert payload["breakers"]["serve.admission"] == "open"
+
+    def test_health_helper_without_server(self):
+        reg = Registry()
+        obs_http.heartbeat(reg, "train/loop", period=10.0)
+        payload = obs_http.health(reg)
+        assert payload["status"] == "ok"
+        assert "train/loop" in payload["components"]
+        # disabled registry: no components, never degraded
+        assert obs_http.health(Registry(enabled=False))["status"] == "ok"
+
+
+class TestGating:
+    def test_resolve_port_precedence(self, monkeypatch):
+        from textsummarization_on_flink_tpu.config import HParams
+
+        monkeypatch.delenv("TS_OBS_HTTP", raising=False)
+        assert obs_http.resolve_http_port(None) == 0
+        assert obs_http.resolve_http_port(HParams()) == 0
+        monkeypatch.setenv("TS_OBS_HTTP", "9464")
+        assert obs_http.resolve_http_port(HParams()) == 9464
+        # explicit HParams port wins over the env
+        assert obs_http.resolve_http_port(
+            HParams(obs_http_port=9465)) == 9465
+        monkeypatch.setenv("TS_OBS_HTTP", "not-a-port")
+        assert obs_http.resolve_http_port(None) == 0
+
+    def test_maybe_serve_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("TS_OBS_HTTP", raising=False)
+        assert obs_http.maybe_serve(Registry()) is None
+        assert obs_http.maybe_serve(Registry(enabled=False)) is None
+
+    def test_hparams_validation(self):
+        from textsummarization_on_flink_tpu.config import HParams
+
+        with pytest.raises(ValueError, match="obs_http_port"):
+            HParams(obs_http_port=70000).validate()
+        with pytest.raises(ValueError, match="flight_frames"):
+            HParams(flight_frames=-1).validate()
+        HParams(obs_http_port=9464, flight_frames=16).validate()
+
+    def test_facade_serve_http(self):
+        reg = Registry()
+        with obs.use_registry(reg):
+            srv = obs.serve_http(0)
+        try:
+            status, _ = _get(srv.port, "/metrics")
+            assert status == 200
+        finally:
+            srv.close()

@@ -281,3 +281,43 @@ class TestSummaryWriterSatellite:
         w.flush()
         assert os.path.getsize(path) > 0
         w.close()
+
+
+class TestTrainPathTracing:
+    """ISSUE 9: the request-scoped trace layer mirrors into the train
+    path — every metrics-flush span of one run carries the run's
+    TraceContext, and per-step flight frames accumulate."""
+
+    def test_run_spans_share_one_trace_and_frames_record(
+            self, tmp_path, vocab):
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="t",
+                       metrics_every=2, flight_frames=8)
+        with obs.use_registry(Registry()) as reg:
+            batcher = Batcher("", vocab, hps, single_pass=True,
+                              example_source=make_source(64))
+            tr = Trainer(hps, vocab.size(), batcher,
+                         train_dir=str(tmp_path / "t"))
+            assert tr._trace is not None
+            tr.train(num_steps=6)
+            spans = [s for s in obs.tracer_for(reg).finished()
+                     if s.name == "train/metrics_flush"]
+            assert spans, "no metrics_flush spans recorded"
+            # one run = one trace: every flush span links to the run root
+            assert {s.trace_id for s in spans} == {tr._trace.trace_id}
+            assert {s.parent_id for s in spans} == {tr._trace.span_id}
+            assert all(s.attrs["step"] >= 0 for s in spans)
+            # per-step frames rang through the recorder (newest kept)
+            frames = reg.flight.frames()
+            assert [f["step"] for f in frames] == list(range(6))[-8:]
+            assert all(f["kind"] == "train_step" and "loss" in f
+                       for f in frames)
+
+    def test_flight_frames_zero_disables_recorder(self, tmp_path, vocab):
+        hps = hps_tiny(log_root=str(tmp_path), exp_name="t",
+                       flight_frames=0)
+        with obs.use_registry(Registry()) as reg:
+            batcher = Batcher("", vocab, hps, single_pass=True,
+                              example_source=make_source(8))
+            Trainer(hps, vocab.size(), batcher,
+                    train_dir=str(tmp_path / "t"))
+            assert reg.flight is None

@@ -775,3 +775,150 @@ class TestServingIntegration:
         assert reg.counter("serve/shed_total").value == sheds
         assert reg.counter("serve/completed_total").value == \
             len(admitted) + 1
+
+
+# -- request-scoped tracing acceptance (ISSUE 9) ---------------------------
+
+class TestRequestTracing:
+    """Acceptance: in a 32-concurrent-request run, every admitted uuid's
+    events in events.jsonl form ONE connected trace (enqueue->resolve,
+    one trace_id, no orphans) — in BOTH serve modes."""
+
+    N = 32
+
+    def _run_server(self, tmp_path, reg, hps, **server_kw):
+        import json
+
+        sink = obs.install_event_sink(str(tmp_path), flush_secs=0.05,
+                                      reg=reg)
+        server = ServingServer(hps, make_vocab(), registry=reg,
+                               **server_kw)
+        with server:
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                futs = list(ex.map(
+                    lambda i: server.submit("the cat sat .", uuid=f"u{i}",
+                                            block=True),
+                    range(self.N)))
+            results = [f.result(timeout=60) for f in futs]
+        sink.close()
+        assert sorted(r.uuid for r in results) == sorted(
+            f"u{i}" for i in range(self.N))
+        recs = [json.loads(ln)
+                for ln in open(tmp_path / "events.jsonl", encoding="utf-8")]
+        by_uuid = {}
+        for r in recs:
+            if r.get("kind") == "request":
+                by_uuid.setdefault(r["uuid"], []).append(r)
+        return recs, by_uuid
+
+    def _assert_connected(self, by_uuid, required):
+        assert sorted(by_uuid) == sorted(f"u{i}" for i in range(self.N))
+        trace_ids = {}
+        for uuid, events in by_uuid.items():
+            stages = [e["event"] for e in events]
+            assert required <= set(stages), (uuid, stages)
+            # connected: ONE trace_id and ONE root span_id across every
+            # event of the request — no orphan fragments
+            assert len({e["trace_id"] for e in events}) == 1, uuid
+            assert len({e["span_id"] for e in events}) == 1, uuid
+            # ordered: lifecycle timestamps never run backwards
+            ts = [e["ts_us"] for e in events]
+            assert ts == sorted(ts), uuid
+            assert stages[0] == "enqueue" and stages[-1] == "resolve", uuid
+            trace_ids[uuid] = events[0]["trace_id"]
+        # distinct requests never share a trace
+        assert len(set(trace_ids.values())) == self.N
+
+    def test_microbatch_traces_connected(self, tmp_path, _isolated_obs):
+        reg = _isolated_obs
+        hps = tiny_hps(serve_max_wait_ms=5.0)
+        _, by_uuid = self._run_server(tmp_path, reg, hps,
+                                      decoder=StubDecoder())
+        self._assert_connected(
+            by_uuid, {"enqueue", "admit", "finish", "resolve"})
+
+    def test_continuous_traces_connected_with_slot_events(
+            self, tmp_path, _isolated_obs):
+        reg = _isolated_obs
+        hps = tiny_hps(serve_mode="continuous")
+        engine = StubEngine(slots=4, chunk=2,
+                            chunks_for=lambda ex: 2)
+        _, by_uuid = self._run_server(tmp_path, reg, hps,
+                                      decoder=StubDecoder(), engine=engine)
+        self._assert_connected(
+            by_uuid, {"enqueue", "admit", "slot", "finish", "resolve"})
+        # the slot event carries the physical placement (slot @ tick)
+        for uuid, events in by_uuid.items():
+            slot_ev = next(e for e in events if e["event"] == "slot")
+            assert 0 <= slot_ev["attrs"]["slot"] < 4
+            assert slot_ev["attrs"]["tick"] >= 1
+            fin = next(e for e in events if e["event"] == "finish")
+            assert fin["attrs"]["chunks"] >= 1
+
+    def test_eviction_still_closes_the_trace(self, tmp_path, _isolated_obs):
+        """A queue-expired request's trace still ends in resolve (with
+        the typed error) — evictions cannot orphan a trace."""
+        import json
+
+        reg = _isolated_obs
+        sink = obs.install_event_sink(str(tmp_path), flush_secs=0.05,
+                                      reg=reg)
+        hps = tiny_hps(serve_mode="continuous")
+        engine = StubEngine(slots=2, chunk=2)
+        server = ServingServer(hps, make_vocab(), decoder=StubDecoder(),
+                               engine=engine, registry=reg)
+        # expired before the server ever starts: refill evicts it typed
+        req = make_request(hps, make_vocab(), uuid="late",
+                           deadline=Deadline(time.monotonic() - 1.0),
+                           registry=reg)
+        server._queue.submit(req)
+        with server:
+            ok = server.submit("the dog ran .", uuid="ok")
+            assert ok.result(timeout=30).uuid == "ok"
+        with pytest.raises(DeadlineExceededError):
+            req.future.result(timeout=1)
+        sink.close()
+        recs = [json.loads(ln)
+                for ln in open(tmp_path / "events.jsonl", encoding="utf-8")]
+        late = [r for r in recs if r.get("kind") == "request"
+                and r["uuid"] == "late"]
+        stages = [e["event"] for e in late]
+        assert stages[0] == "enqueue" and stages[-1] == "resolve"
+        assert "evict" in stages
+        resolve = late[-1]
+        assert resolve["attrs"]["error"] == "DeadlineExceededError"
+        assert len({e["trace_id"] for e in late}) == 1
+
+    def test_shed_request_emits_shed_event(self, tmp_path, _isolated_obs):
+        reg = _isolated_obs
+        sink = obs.install_event_sink(str(tmp_path), flush_secs=0.05,
+                                      reg=reg)
+        q = RequestQueue(1, registry=reg)
+        q.submit(make_request(tiny_hps(), make_vocab(), uuid="first"))
+        with pytest.raises(ServeOverloadError):
+            q.submit(make_request(tiny_hps(), make_vocab(), uuid="second"))
+        sink.close()
+        import json
+
+        recs = [json.loads(ln)
+                for ln in open(tmp_path / "events.jsonl", encoding="utf-8")]
+        second = [r for r in recs if r.get("kind") == "request"
+                  and r["uuid"] == "second"]
+        # an honest timeline: the request reached the queue and bounced
+        assert [r["event"] for r in second] == ["enqueue", "shed"]
+        assert second[1]["attrs"]["cause"] == "queue_full"
+
+
+class TestDarkJobTracing:
+    def test_disabled_registry_skips_the_trace_mint(self):
+        """A dark job (obs=False / TS_OBS=0) must not pay the urandom
+        mint per request: no consumer could ever read the ids."""
+        from textsummarization_on_flink_tpu.obs import Registry as _Reg
+
+        dark = _Reg(enabled=False)
+        req = make_request(tiny_hps(), make_vocab(), uuid="dark",
+                           registry=dark)
+        assert req.trace is None and req.future.trace is None
+        # and resolution still works without a trace
+        req.future._resolve("ok")
+        assert req.future.result(timeout=1) == "ok"

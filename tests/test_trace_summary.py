@@ -96,3 +96,100 @@ def test_cli_renders_table_and_json(trace_dir, capsys):
 def test_cli_errors_without_capture(tmp_path, capsys):
     assert trace_summary.main([str(tmp_path)]) == 1
     assert "capture" in capsys.readouterr().err
+
+
+# -- request timelines (ISSUE 9 satellite) ---------------------------------
+
+@pytest.fixture
+def events_file(tmp_path):
+    t0 = 1_700_000_000_000_000
+    recs = [
+        {"kind": "request", "event": "enqueue", "uuid": "u7",
+         "trace_id": "t7", "span_id": "s7", "ts_us": t0,
+         "attrs": {"depth": 1}},
+        {"kind": "request", "event": "admit", "uuid": "u7",
+         "trace_id": "t7", "span_id": "s7", "ts_us": t0 + 2_000,
+         "attrs": {"queue_ms": 2.0}},
+        {"kind": "request", "event": "slot", "uuid": "u7",
+         "trace_id": "t7", "span_id": "s7", "ts_us": t0 + 2_100,
+         "attrs": {"slot": 3, "tick": 9}},
+        {"kind": "span", "name": "serve/dispatch", "trace_id": "t7",
+         "span_id": "sp1", "ts_us": t0 + 2_200, "dur_us": 1_000,
+         "pid": 1, "tid": 1},
+        {"kind": "request", "event": "finish", "uuid": "u7",
+         "trace_id": "t7", "span_id": "s7", "ts_us": t0 + 9_000,
+         "attrs": {"chunks": 4}},
+        {"kind": "request", "event": "resolve", "uuid": "u7",
+         "trace_id": "t7", "span_id": "s7", "ts_us": t0 + 9_500},
+        # a NEIGHBOR request: must not leak into u7's timeline
+        {"kind": "request", "event": "enqueue", "uuid": "u8",
+         "trace_id": "t8", "span_id": "s8", "ts_us": t0 + 100},
+        # scalar record + junk line tolerance
+        {"step": 3, "loss": 2.5},
+    ]
+    p = tmp_path / "events.jsonl"
+    with open(p, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        f.write("{broken tail\n")
+    return p
+
+
+class TestRequestTimeline:
+    def test_reconstructs_phases_and_spans(self, events_file):
+        tl = trace_summary.request_timeline([str(events_file)], "u7")
+        assert [e["event"] for e in tl["events"]] == [
+            "enqueue", "admit", "slot", "finish", "resolve"]
+        assert tl["trace_id"] == "t7"
+        assert tl["phases"] == {"queue_ms": 2.0, "resident_ms": 7.0,
+                                "resolve_ms": 0.5, "total_ms": 9.5}
+        # the trace's spans ride along; the neighbor's do not
+        assert [s["name"] for s in tl["spans"]] == ["serve/dispatch"]
+
+    def test_evicted_request_resident_falls_back_to_resolve(self, tmp_path):
+        recs = [
+            {"kind": "request", "event": "enqueue", "uuid": "u1",
+             "trace_id": "t1", "span_id": "s1", "ts_us": 1_000_000},
+            {"kind": "request", "event": "admit", "uuid": "u1",
+             "trace_id": "t1", "span_id": "s1", "ts_us": 1_500_000},
+            {"kind": "request", "event": "evict", "uuid": "u1",
+             "trace_id": "t1", "span_id": "s1", "ts_us": 1_600_000},
+            {"kind": "request", "event": "resolve", "uuid": "u1",
+             "trace_id": "t1", "span_id": "s1", "ts_us": 1_700_000,
+             "attrs": {"error": "DeadlineExceededError"}},
+        ]
+        p = tmp_path / "events.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        tl = trace_summary.request_timeline([str(p)], "u1")
+        assert tl["phases"]["resident_ms"] == 200.0  # admit -> resolve
+        assert "resolve_ms" not in tl["phases"]
+        assert tl["phases"]["total_ms"] == 700.0
+
+    def test_cli_text_and_json(self, events_file, capsys):
+        assert trace_summary.main(
+            [str(events_file), "--request", "u7"]) == 0
+        out = capsys.readouterr().out
+        assert "request 'u7' (trace t7)" in out
+        assert "slot (slot=3, tick=9)" in out
+        assert "queue 2.000 ms" in out and "total 9.500 ms" in out
+        assert "serve/dispatch" in out
+        assert trace_summary.main(
+            [str(events_file), "--request", "u7", "--json"]) == 0
+        tl = json.loads(capsys.readouterr().out)
+        assert tl["phases"]["total_ms"] == 9.5
+
+    def test_cli_directory_argument(self, events_file, capsys):
+        assert trace_summary.main(
+            [str(events_file.parent), "--request", "u8", "--json"]) == 0
+        tl = json.loads(capsys.readouterr().out)
+        assert [e["event"] for e in tl["events"]] == ["enqueue"]
+
+    def test_unknown_uuid_errors(self, events_file, capsys):
+        assert trace_summary.main(
+            [str(events_file), "--request", "nope"]) == 1
+        assert "no request events" in capsys.readouterr().err
+
+    def test_no_events_jsonl_errors(self, tmp_path, capsys):
+        assert trace_summary.main(
+            [str(tmp_path), "--request", "u1"]) == 1
+        assert "events.jsonl" in capsys.readouterr().err

@@ -137,6 +137,18 @@ class HParams:
     # hands the component null metrics.  The process-wide kill switch is
     # TS_OBS=0 (read once, at default-registry creation).
     obs: bool = True
+    # ---- live telemetry plane (OBSERVABILITY.md; ISSUE 9) ----
+    # Exposition HTTP port for /metrics, /healthz, /snapshot, /spans
+    # (obs/http.py; binds 127.0.0.1 only).  0 (default) = off; the
+    # process-wide TS_OBS_HTTP=<port> env var enables it when this is
+    # unset.  One server per process (first enabler wins).
+    obs_http_port: int = 0
+    # Flight-recorder ring capacity in frames (obs/flightrec.py): the
+    # newest N per-step / per-tick frames kept in memory and dumped to
+    # flight_<reason>.jsonl when a typed failure trigger fires (NaN
+    # watchdog/rollback, serve dispatch failure, breaker open,
+    # eviction storm).  0 disables frame recording and dumps.
+    flight_frames: int = 64
     # SummaryWriter flush cadence in records: 1 flushes every write
     # (historical behavior), k>1 buffers k records per flush (the
     # reference flushes every 100 steps, run_summarization.py:242-244)
@@ -389,6 +401,12 @@ class HParams:
         if self.steps_per_dispatch < 1:
             raise ValueError(f"steps_per_dispatch must be >= 1, got "
                              f"{self.steps_per_dispatch}")
+        if not 0 <= self.obs_http_port <= 65535:
+            raise ValueError(f"obs_http_port must be in [0, 65535] "
+                             f"(0 = off), got {self.obs_http_port}")
+        if self.flight_frames < 0:
+            raise ValueError(f"flight_frames must be >= 0 (0 = off), got "
+                             f"{self.flight_frames}")
         if self.summary_flush_every < 1:
             raise ValueError(f"summary_flush_every must be >= 1, got "
                              f"{self.summary_flush_every}")

@@ -608,13 +608,19 @@ class SlotDecodeEngine:
         if not self._active.any():
             return []
         params = self._params()
-        self._state, finished = self._jitted(
-            beam_search.step_slots_jit, params, self._hps, self._state,
-            self._active, self.chunk)
-        self._state = self._pin_state(self._state)
-        # the one sanctioned chunk-boundary sync: the host scheduler
-        # needs the finished mask to retire and refill slots
-        return [int(i) for i in np.nonzero(np.asarray(finished))[0]]
+        # chunk-level span: tick-scoped, not request-scoped (a chunk
+        # serves every resident at once, so there is no single parent
+        # trace) — a request's timeline correlates with these spans by
+        # timestamp via its slot/tick lifecycle events, not by trace_id
+        with obs.spans.span(self._obs, "decode/slot_chunk",
+                            active=int(self._active.sum())):
+            self._state, finished = self._jitted(
+                beam_search.step_slots_jit, params, self._hps, self._state,
+                self._active, self.chunk)
+            self._state = self._pin_state(self._state)
+            # the one sanctioned chunk-boundary sync: the host scheduler
+            # needs the finished mask to retire and refill slots
+            return [int(i) for i in np.nonzero(np.asarray(finished))[0]]
 
     def unpack(self, idx: int, example) -> DecodedResult:
         """Retire slot `idx`: finalize its hypothesis and free the slot.

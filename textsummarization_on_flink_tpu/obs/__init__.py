@@ -54,19 +54,22 @@ from textsummarization_on_flink_tpu.obs.registry import (
 from textsummarization_on_flink_tpu.obs.spans import (
     NULL_SPAN,
     SpanRecord,
+    TraceContext,
     Tracer,
+    request_event as _request_event,
     span as _span,
     tracer_for,
 )
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Tracer", "SpanRecord",
-    "EventSink", "NULL_REGISTRY", "NULL_COUNTER", "NULL_GAUGE",
-    "NULL_HISTOGRAM", "NULL_SPAN", "DEFAULT_TIME_BUCKETS",
+    "TraceContext", "EventSink", "NULL_REGISTRY", "NULL_COUNTER",
+    "NULL_GAUGE", "NULL_HISTOGRAM", "NULL_SPAN", "DEFAULT_TIME_BUCKETS",
     "exponential_buckets", "enabled_from_env", "registry", "registry_for",
     "set_default_registry", "use_registry", "counter", "gauge", "histogram",
-    "span", "render_text", "snapshot", "snapshot_event", "install_event_sink",
-    "write_chrome_trace", "tracer_for",
+    "span", "request_event", "render_text", "snapshot", "snapshot_event",
+    "install_event_sink", "write_chrome_trace", "tracer_for", "heartbeat",
+    "install_flight_recorder", "serve_http",
 ]
 
 _default: Optional[Registry] = None
@@ -143,8 +146,47 @@ def histogram(name: str, buckets: Optional[Sequence[float]] = None,
     return registry().histogram(name, buckets)
 
 
-def span(name: str, **attrs: Any):
-    return _span(registry(), name, **attrs)
+def span(name: str, parent: Optional["TraceContext"] = None, **attrs: Any):
+    return _span(registry(), name, parent=parent, **attrs)
+
+
+def request_event(event: str, ctx: Optional["TraceContext"], uuid: str,
+                  **attrs: Any) -> bool:
+    return _request_event(registry(), event, ctx, uuid, **attrs)
+
+
+def heartbeat(name: str, period: float = 10.0) -> None:
+    """Record a component liveness beat on the default registry's
+    heartbeat board (`/healthz` flips it degraded when stale — the live
+    exposition plane, obs/http.py).  Lazy import keeps obs itself free
+    of http.server until someone actually beats or serves."""
+    from textsummarization_on_flink_tpu.obs import http as http_mod
+
+    http_mod.heartbeat(registry(), name, period=period)
+
+
+def install_flight_recorder(directory: str, capacity: Optional[int] = None,
+                            reg: Optional[Registry] = None):
+    """Attach a failure flight recorder (obs/flightrec.py) to `reg` (the
+    default registry when None); returns it, or None when disabled.
+    ``capacity`` follows the HParams.flight_frames convention: None =
+    the module default ring, 0 = disabled (returns None)."""
+    if capacity == 0:
+        return None
+    from textsummarization_on_flink_tpu.obs import flightrec as flight_mod
+
+    kw = {"capacity": capacity} if capacity is not None else {}
+    return flight_mod.install_flight_recorder(
+        reg if reg is not None else registry(), directory, **kw)
+
+
+def serve_http(port: int, reg: Optional[Registry] = None):
+    """Start the live exposition plane (obs/http.py) on 127.0.0.1:port
+    over `reg` (default registry when None); returns the server."""
+    from textsummarization_on_flink_tpu.obs import http as http_mod
+
+    return http_mod.ObsHttpServer(
+        reg if reg is not None else registry(), port=port).start()
 
 
 def render_text() -> str:

@@ -47,6 +47,14 @@ class EventSink:
         reg = registry if registry is not None else Registry(enabled=True)
         self._dropped = reg.counter("obs/events_dropped_total")
         self._write_errors = reg.counter("obs/sink_write_errors_total")
+        # gap annotation (ISSUE 9 satellite): drops since the last flush
+        # cycle, folded into the stream as one {"kind": "drops"} record
+        # so a hole in events.jsonl is visible IN the file, not only in
+        # the counter.  Own lock: touched only on the (already
+        # overloaded) drop path and once per flush cycle.
+        self._drop_note_lock = threading.Lock()
+        self._pending_drops = 0
+        self._registry = reg
         self._f = None
         self._closed = threading.Event()
         self._kick = threading.Event()  # close()/flush() fast-forward
@@ -65,13 +73,19 @@ class EventSink:
         full or the sink is closed."""
         if self._closed.is_set():
             self._dropped.inc()
+            self._note_drop()
             return False
         try:
             self._q.put_nowait(record)
             return True
         except queue.Full:
             self._dropped.inc()
+            self._note_drop()
             return False
+
+    def _note_drop(self) -> None:
+        with self._drop_note_lock:
+            self._pending_drops += 1
 
     # -- flusher --
     def _open(self) -> bool:
@@ -127,14 +141,38 @@ class EventSink:
             self._gen += 1
             self._gen_cv.notify_all()
 
+    def _annotated(self, batch: List[dict]) -> List[dict]:
+        """Fold any drop episode since the last cycle into the stream as
+        one ``{"kind": "drops", "count": N}`` record.  The drops
+        happened because the queue was full of exactly the records being
+        drained now, so the hole sits AFTER them in file order (a
+        best-effort position — racing emits may interleave)."""
+        with self._drop_note_lock:
+            n, self._pending_drops = self._pending_drops, 0
+        if n:
+            import time as _t
+
+            batch.append({"kind": "drops", "count": n,
+                          "ts_us": int(_t.time() * 1e6)})
+        return batch
+
     def _run(self) -> None:
+        from textsummarization_on_flink_tpu.obs import http as http_mod
+
+        period = max(self._flush_secs, 1.0)
         while not self._closed.is_set():
+            # the flusher is a component of the live plane: its own
+            # heartbeat makes a wedged sink visible on /healthz
+            http_mod.heartbeat(self._registry, "obs/event_sink",
+                               period=period)
             self._kick.wait(self._flush_secs)
             self._kick.clear()
-            self._write_batch(self._drain())
+            self._write_batch(self._annotated(self._drain()))
             self._bump_gen()
-        self._write_batch(self._drain())  # final flush
+        self._write_batch(self._annotated(self._drain()))  # final flush
         self._bump_gen()
+        # clean shutdown: a closed sink must not hold /healthz degraded
+        http_mod.retire_heartbeat(self._registry, "obs/event_sink")
         if self._f is not None:
             try:
                 self._f.close()
@@ -167,6 +205,35 @@ class EventSink:
         self._closed.set()
         self._kick.set()
         self._thread.join(timeout=timeout)
+
+
+class MemorySink:
+    """In-memory EventSink stand-in (same ``emit`` contract): records
+    land in a bounded list instead of a file.  For tests and for
+    bench.py's trace-derived per-request breakdown, where spinning a
+    flusher thread and parsing JSONL back would only add noise."""
+
+    def __init__(self, max_records: int = 100_000):
+        self._lock = threading.Lock()
+        self._records: List[dict] = []
+        self._max = max_records
+
+    def emit(self, record: Dict[str, Any]) -> bool:
+        with self._lock:
+            if len(self._records) >= self._max:
+                return False
+            self._records.append(record)
+            return True
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def flush(self, timeout: float = 0.0) -> None:
+        pass  # synchronous by construction
+
+    def close(self, timeout: float = 0.0) -> None:
+        pass
 
 
 def install_event_sink(registry: Registry, directory: str,

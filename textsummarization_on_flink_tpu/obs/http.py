@@ -1,0 +1,324 @@
+"""Live telemetry exposition plane (ISSUE 9 tentpole, piece 2).
+
+``obs.render_text()`` has promised a Prometheus scrape surface since
+ISSUE 1 ("text exposition is Prometheus-style so a scrape endpoint can
+be bolted on without touching call sites"); this module bolts it on.
+One stdlib ``ThreadingHTTPServer`` — no new dependencies — bound to
+**localhost only** (the plane exposes internal state; anything wider is
+a reverse proxy's job), OFF by default and enabled per process via
+``TS_OBS_HTTP=<port>`` or per job via ``HParams(obs_http_port=...)``.
+
+Endpoints (all GET, all read-only):
+
+  * ``/metrics``  — ``registry.render_text()`` verbatim (text/plain):
+    what Prometheus scrapes is byte-identical to what the in-process
+    exposition renders, asserted by test;
+  * ``/healthz``  — component liveness: heartbeats registered by the
+    trainer loop / serve dispatch thread / EventSink flusher
+    (``obs.heartbeat(name, period)``) plus every circuit breaker's
+    state; any STALE HEARTBEAT flips the JSON status to "degraded" and
+    the HTTP status to 503 (load balancers understand).  Breaker states
+    are reported but informational — see health() for why 503-ing an
+    open admission breaker would pin it open;
+  * ``/snapshot`` — ``registry.snapshot(compact=True)`` as JSON;
+  * ``/spans``    — the newest buffered spans as unified event records
+    (``?n=<count>``, default 200).
+
+Staleness is computed from each component's own declared period (stale
+= age > STALE_FACTOR * period) on the injectable monotonic clock, so
+tests flip /healthz without sleeping.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import os
+import threading
+import time
+import urllib.parse
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from textsummarization_on_flink_tpu.obs import spans as spans_lib
+from textsummarization_on_flink_tpu.obs.registry import Registry
+
+log = logging.getLogger(__name__)
+
+#: a heartbeat is stale once its age exceeds this many of its own
+#: declared periods (3x tolerates one missed beat plus scheduling slop
+#: without masking a genuinely wedged component)
+STALE_FACTOR = 3.0
+
+#: the declared period for the train/serve LOOP heartbeats (one beat
+#: per iteration): deliberately generous — a single iteration
+#: legitimately blocks for a first-call jit compile, a checkpoint
+#: save, or the windowed metrics D2H, and none of those may 503 a
+#: healthy process; steady-state wedges still surface within
+#: STALE_FACTOR * this (~6 minutes).  ONE constant so the two loops'
+#: /healthz semantics can never drift.
+LOOP_HEARTBEAT_PERIOD = 120.0
+
+_BREAKER_STATES = {0: "closed", 1: "half_open", 2: "open"}
+
+
+class HeartbeatBoard:
+    """Component liveness: name -> (last beat, declared period).
+
+    ``beat()`` is the hot-path side (one dict store under a lock per
+    loop iteration); ``status()`` is the scrape side.  The clock is
+    injectable so staleness tests never sleep.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._beats: Dict[str, Tuple[float, float]] = {}
+
+    def beat(self, name: str, period: float = 10.0) -> None:
+        with self._lock:
+            self._beats[name] = (self._clock(), float(period))
+
+    def retire(self, name: str) -> None:
+        """Deregister a component that legitimately finished (a trainer
+        that completed, a server that stopped): its silence is not a
+        failure and must not hold /healthz at 503 for the rest of the
+        process."""
+        with self._lock:
+            self._beats.pop(name, None)
+
+    def status(self, stale_factor: float = STALE_FACTOR,
+               ) -> Dict[str, Dict[str, Any]]:
+        """{name: {age_seconds, period_seconds, ok}} — ok=False once the
+        age exceeds stale_factor x the component's own period."""
+        now = self._clock()
+        with self._lock:
+            beats = dict(self._beats)
+        return {
+            name: {
+                "age_seconds": round(now - last, 3),
+                "period_seconds": period,
+                "ok": (now - last) <= stale_factor * period,
+            }
+            for name, (last, period) in sorted(beats.items())
+        }
+
+
+_board_init_lock = threading.Lock()
+
+
+def board_for(registry: Registry) -> HeartbeatBoard:
+    """The registry's heartbeat board, created on first use (same
+    double-checked pattern as spans.tracer_for)."""
+    b = registry.heartbeats
+    if b is None:
+        with _board_init_lock:
+            b = registry.heartbeats
+            if b is None:
+                b = HeartbeatBoard()
+                registry.heartbeats = b
+    return b
+
+
+def heartbeat(registry: Registry, name: str, period: float = 10.0) -> None:
+    """Record one liveness beat for `name` (no-op when disabled)."""
+    if not registry.enabled:
+        return
+    board_for(registry).beat(name, period=period)
+
+
+def retire_heartbeat(registry: Registry, name: str) -> None:
+    """Deregister `name` from `registry`'s board (component finished
+    cleanly); no-op when disabled or never registered."""
+    if not registry.enabled or registry.heartbeats is None:
+        return
+    registry.heartbeats.retire(name)
+
+
+def health(registry: Registry,
+           stale_factor: float = STALE_FACTOR) -> Dict[str, Any]:
+    """The /healthz payload: heartbeat statuses + breaker states.
+
+    Only a STALE HEARTBEAT degrades (ISSUE 9: "stale-heartbeat ->
+    degraded").  Breaker states are reported but informational, for two
+    reasons: the ``*/breaker_state`` gauge only refreshes on the next
+    ``allow()`` call, so an OPEN reading may already be past its reset
+    window; and 503-ing on an open ADMISSION breaker is a
+    self-sustaining trap — the load balancer drains the instance, no
+    traffic arrives, no half-open probe ever runs, and the breaker can
+    never close again.  Scrapers that want to alert on breakers read
+    the ``breakers`` map (or /metrics) directly."""
+    components = (board_for(registry).status(stale_factor)
+                  if registry.enabled else {})
+    breakers: Dict[str, str] = {}
+    for name in registry.names():
+        if not name.endswith("/breaker_state"):
+            continue
+        metric = registry.get(name)
+        code = int(getattr(metric, "value", 0))
+        # resilience/<name>/breaker_state -> <name>
+        short = name[len("resilience/"):-len("/breaker_state")] \
+            if name.startswith("resilience/") else name
+        breakers[short] = _BREAKER_STATES.get(code, str(code))
+    degraded = any(not c["ok"] for c in components.values())
+    return {
+        "status": "degraded" if degraded else "ok",
+        "components": components,
+        "breakers": breakers,
+    }
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    """Routes the four endpoints over the registry the server wraps."""
+
+    server_version = "ts-obs/1"
+    registry: Registry = None  # type: ignore[assignment] # set per server
+
+    # -- plumbing --
+    def log_message(self, fmt: str, *args: Any) -> None:
+        log.debug("obs-http %s", fmt % args)  # never spam stderr
+
+    def _send(self, code: int, body: bytes,
+              content_type: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper went away mid-write; nothing to recover
+
+    def _send_json(self, code: int, payload: Any) -> None:
+        self._send(code, (json.dumps(payload) + "\n").encode("utf-8"))
+
+    # -- routes --
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        parsed = urllib.parse.urlparse(self.path)
+        route = parsed.path.rstrip("/") or "/"
+        reg = self.registry
+        try:
+            if route == "/metrics":
+                self._send(200, reg.render_text().encode("utf-8"),
+                           content_type="text/plain; version=0.0.4")
+            elif route == "/healthz":
+                payload = health(reg)
+                self._send_json(200 if payload["status"] == "ok" else 503,
+                                payload)
+            elif route == "/snapshot":
+                self._send_json(200, reg.snapshot(compact=True))
+            elif route == "/spans":
+                qs = urllib.parse.parse_qs(parsed.query)
+                try:
+                    n = max(1, int(qs.get("n", ["200"])[0]))
+                except ValueError:
+                    n = 200
+                recs = spans_lib.tracer_for(reg).finished() if reg.enabled \
+                    else []
+                self._send_json(200, [r.as_event() for r in recs[-n:]])
+            else:
+                self._send_json(404, {"error": f"no route {route!r}",
+                                      "routes": ["/metrics", "/healthz",
+                                                 "/snapshot", "/spans"]})
+        except Exception:  # tslint: disable=TS005 — exposition must never kill the scrape thread; failures are counted and answered with a 500
+            reg.counter("obs/http_errors_total").inc()
+            log.exception("obs-http handler failed for %s", self.path)
+            try:
+                self._send_json(500, {"error": "internal"})
+            except Exception:  # tslint: disable=TS005 — socket already gone; the error counter above recorded the failure
+                pass
+
+
+class ObsHttpServer:
+    """The exposition plane over one registry: localhost-only
+    ThreadingHTTPServer on a daemon thread.
+
+        srv = ObsHttpServer(registry, port=9464).start()
+        ... GET http://127.0.0.1:{srv.port}/metrics ...
+        srv.close()
+
+    ``port=0`` binds an OS-assigned ephemeral port (tests); the bound
+    port is always on ``.port``.
+    """
+
+    def __init__(self, registry: Registry, port: int = 0,
+                 host: str = "127.0.0.1"):
+        handler = type("_BoundHandler", (_Handler,), {"registry": registry})
+        self._httpd = http.server.ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._registry = registry
+
+    def start(self) -> "ObsHttpServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="obs-http")
+            self._thread.start()
+            log.info("obs exposition plane listening on http://%s:%d "
+                     "(/metrics /healthz /snapshot /spans)",
+                     self.host, self.port)
+        return self
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self) -> "ObsHttpServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+_default_server: Optional[ObsHttpServer] = None
+_default_server_lock = threading.Lock()
+
+
+def resolve_http_port(hps: Any = None) -> int:
+    """The exposition port for this job: ``HParams.obs_http_port`` when
+    set (> 0), else ``TS_OBS_HTTP=<port>``, else 0 (off)."""
+    if hps is not None and getattr(hps, "obs_http_port", 0):
+        return int(hps.obs_http_port)
+    raw = os.environ.get("TS_OBS_HTTP", "").strip()
+    if not raw:
+        return 0
+    try:
+        port = int(raw)
+    except ValueError:
+        port = -1
+    if not 0 < port <= 65535:
+        # the env contract is log-and-stay-off, NEVER crash the job: an
+        # out-of-range port would raise OverflowError at bind, past
+        # maybe_serve's OSError net, killing Trainer/ServingServer init
+        log.warning("TS_OBS_HTTP=%r is not a valid port (1-65535); "
+                    "exposition plane stays off", raw)
+        return 0
+    return port
+
+
+def maybe_serve(registry: Registry, hps: Any = None,
+                ) -> Optional[ObsHttpServer]:
+    """Start the process-wide exposition plane when configured (one
+    server per process — the first enabler wins; later calls return the
+    running instance).  None when off (the default) or disabled."""
+    global _default_server
+    if not registry.enabled:
+        return None
+    port = resolve_http_port(hps)
+    if port <= 0:
+        return None
+    with _default_server_lock:
+        if _default_server is None:
+            try:
+                _default_server = ObsHttpServer(registry, port=port).start()
+            except OSError as e:
+                log.warning("obs exposition plane failed to bind port %d: "
+                            "%s", port, e)
+                return None
+        return _default_server

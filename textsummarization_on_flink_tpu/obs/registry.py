@@ -299,6 +299,11 @@ class Registry:
         # (wired by obs.spans.Tracer at first span())
         self.tracer = None  # type: ignore[assignment]
         self.event_sink = None  # obs.export.EventSink, when installed
+        # the live telemetry plane's per-registry state (ISSUE 9):
+        # component heartbeats (obs.http.board_for) and the failure
+        # flight recorder (obs.flightrec.install_flight_recorder)
+        self.heartbeats = None  # obs.http.HeartbeatBoard
+        self.flight = None  # obs.flightrec.FlightRecorder
 
     def _get_or_create(self, name: str, cls, *args):
         with self._lock:

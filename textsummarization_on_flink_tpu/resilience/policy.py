@@ -30,6 +30,7 @@ import time
 from typing import Any, Callable, Iterator, Optional, Tuple, Type
 
 from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.obs import flightrec
 from textsummarization_on_flink_tpu.resilience.errors import (
     CircuitOpenError,
     DeadlineExceededError,
@@ -221,6 +222,7 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probe_out = False  # a HALF_OPEN probe is in flight
         reg = registry if registry is not None else obs.registry()
+        self._registry = reg
         self._g_state = reg.gauge(f"resilience/{name}/breaker_state")
         self._c_trips = reg.counter(f"resilience/{name}/breaker_trips_total")
         self._c_shed = reg.counter(f"resilience/{name}/breaker_shed_total")
@@ -263,6 +265,7 @@ class CircuitBreaker:
                 self._set_state(self.CLOSED)
 
     def record_failure(self) -> None:
+        tripped = False
         with self._lock:
             if self._state == self.HALF_OPEN:
                 # the probe failed: back to OPEN, clock restarts
@@ -270,12 +273,20 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._probe_out = False
                 self._c_trips.inc()
-                return
-            self._failures += 1
-            if self._state == self.CLOSED and self._failures >= self.threshold:
-                self._set_state(self.OPEN)
-                self._opened_at = self._clock()
-                self._c_trips.inc()
+                tripped = True
+            else:
+                self._failures += 1
+                if (self._state == self.CLOSED
+                        and self._failures >= self.threshold):
+                    self._set_state(self.OPEN)
+                    self._opened_at = self._clock()
+                    self._c_trips.inc()
+                    tripped = True
+        if tripped:
+            # flight-recorder trigger OUTSIDE the breaker lock (the dump
+            # is file IO): an opening breaker is exactly the moment the
+            # preceding steps/ticks stop being reconstructable later
+            flightrec.trigger(self._registry, f"breaker_{self.name}_open")
 
     def __enter__(self) -> "CircuitBreaker":
         if not self.allow():

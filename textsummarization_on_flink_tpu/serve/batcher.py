@@ -30,6 +30,7 @@ import time
 from typing import Any, List, Optional
 
 from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.obs import flightrec
 from textsummarization_on_flink_tpu.config import HParams, parse_bucket_spec
 from textsummarization_on_flink_tpu.data.batching import Batch
 from textsummarization_on_flink_tpu.data.vocab import Vocab
@@ -174,6 +175,12 @@ class ContinuousBatcher:
         self.slots = int(engine.slots)
         self._resident: List[Optional[ServeRequest]] = [None] * self.slots
         self._chunks = [0] * self.slots  # chunks each resident has seen
+        self._tick = 0  # scheduler rounds (the T of "refill at tick T")
+        # per-tick activity, reset each tick for the flight-recorder
+        # frame (obs/flightrec.py): post-mortems need the rounds BEFORE
+        # a failure, not only the cumulative counters
+        self._tick_evictions = 0
+        self._tick_refills = 0
         reg = registry if registry is not None else obs.registry_for(hps)
         self._reg = reg
         self._g_active = reg.gauge("serve/slots_active")
@@ -204,15 +211,31 @@ class ContinuousBatcher:
         evicted at the chunk boundary — the ISSUE-6 bugfix: a deadline
         is enforced while the request is RESIDENT, not only at admission
         (continuous mode has no dispatch to re-check it)."""
+        evicted = 0
         for idx, req in enumerate(self._resident):
             if req is None or not req.deadline.expired():
                 continue
             self._engine.release(idx)
             self._resident[idx] = None
             self._c_evictions.inc()
+            evicted += 1
+            obs.spans.request_event(
+                self._reg, "evict", req.trace, req.uuid, where="resident",
+                slot=idx, chunks=self._chunks[idx])
             req.future._reject(DeadlineExceededError(
                 f"request {req.uuid!r} deadline expired after "
                 f"{self._chunks[idx]} resident chunk(s)"))
+        self._tick_evictions += evicted
+        if evicted >= max(2, (self.slots + 1) // 2):
+            # an eviction STORM (half the engine thrown away at one
+            # boundary) is a latency incident, not routine aging: leave
+            # the preceding ticks behind for the post-mortem.  The
+            # 2-eviction noise floor means tiny engines (slots<=2)
+            # trigger only on a FULL wipe, and a 1-slot engine never
+            # does — losing its single resident is indistinguishable
+            # from routine deadline aging (documented, OBSERVABILITY.md)
+            flightrec.trigger(self._reg, "eviction_storm",
+                              evicted=evicted, tick=self._tick)
         self._set_active_gauge()
 
     def _refill(self, poll: float) -> None:
@@ -235,13 +258,26 @@ class ContinuousBatcher:
                 # including the expired ones below, whose long waits are
                 # exactly the histogram tail that shows queue pressure
                 # (same population as the micro-batch dispatch path)
-                self._h_queue_time.observe(time.monotonic() - req.enqueue_t)
+                queue_s = time.monotonic() - req.enqueue_t
+                self._h_queue_time.observe(queue_s)
                 if req.deadline.expired():  # died waiting in the queue
                     self._c_evictions.inc()
+                    self._tick_evictions += 1
+                    obs.spans.request_event(
+                        self._reg, "evict", req.trace, req.uuid,
+                        where="queue")
                     req.future._reject(DeadlineExceededError(
                         f"request {req.uuid!r} deadline expired while "
                         f"queued"))
                     continue
+                # admit ONLY for live requests (mirror the micro-batch
+                # dispatch path): a queue-expired request's timeline is
+                # enqueue -> evict -> resolve, never admit -> evict, so
+                # bench's admit-anchored resident split can't count
+                # eviction latency as decode time
+                obs.spans.request_event(
+                    self._reg, "admit", req.trace, req.uuid,
+                    queue_ms=round(queue_s * 1e3, 3))
                 try:
                     self._engine.pack(idx, req.example)
                 except Exception as e:
@@ -254,6 +290,13 @@ class ContinuousBatcher:
                 self._resident[idx] = req
                 self._chunks[idx] = 0
                 self._c_refills.inc()
+                self._tick_refills += 1
+                # the refill-into-slot lifecycle event: WHICH slot at
+                # WHICH tick — the datum aggregate histograms cannot
+                # answer ("why was uuid X slow?")
+                obs.spans.request_event(
+                    self._reg, "slot", req.trace, req.uuid, slot=idx,
+                    tick=self._tick)
                 break
         self._set_active_gauge()
 
@@ -268,26 +311,45 @@ class ContinuousBatcher:
             self._h_resident.observe(self._chunks[idx])
             self._h_e2e.observe(done_t - req.enqueue_t)
             self._c_done.inc()
+            obs.spans.request_event(
+                self._reg, "finish", req.trace, req.uuid, slot=idx,
+                chunks=self._chunks[idx])
             req.future._resolve(res)
         self._set_active_gauge()
+
+    def _record_frame(self, occupancy: float) -> None:
+        """One flight-recorder frame per scheduler round (the serve-tick
+        analogue of the trainer's per-step frame): what the engine was
+        doing on the rounds BEFORE a failure trigger fires."""
+        flightrec.record(
+            self._reg, "serve_tick", tick=self._tick,
+            occupancy=round(occupancy, 4), queue_depth=self._q.qsize(),
+            evictions=self._tick_evictions, refills=self._tick_refills)
 
     def tick(self, poll: float = 0.05) -> bool:
         """One scheduler round: evict -> refill -> step -> harvest.
         Returns False when the engine stayed idle (nothing resident and
         nothing arrived within `poll`) so the caller's loop can re-check
         its stop flag without spinning."""
+        self._tick += 1
+        self._tick_evictions = 0
+        self._tick_refills = 0
         self._evict_expired()
         self._refill(poll)
         if not self.busy():
             return False
+        # the frame lands BEFORE the chunk dispatch, so a failing tick
+        # contributes its own pre-failure frame (refill/evict state) and
+        # the dump holds everything strictly preceding the trigger
+        n_active = sum(r is not None for r in self._resident)
+        self._record_frame(n_active / self.slots)
         with obs.spans.span(
                 self._reg, "serve/dispatch",
-                fill=sum(r is not None for r in self._resident)):
+                fill=n_active, tick=self._tick):
             if self._faults is not None and self._faults.fire(
                     "serve.dispatch"):
                 raise RuntimeError("injected serve.dispatch fault")
             finished = self._engine.step()
-        n_active = sum(r is not None for r in self._resident)
         self._h_occupancy.observe(n_active / self.slots)
         for idx, req in enumerate(self._resident):
             if req is not None:

@@ -52,12 +52,17 @@ class ServeFuture:
     and for sinks that must see one row per request.
     """
 
-    __slots__ = ("uuid", "_event", "_result", "_error", "_lock",
+    __slots__ = ("uuid", "trace", "_event", "_result", "_error", "_lock",
                  "_callbacks", "_registry")
 
     def __init__(self, uuid: str = "",
                  registry: Optional[obs.Registry] = None):
         self.uuid = uuid
+        # the request's TraceContext (set by ServeRequest): resolution
+        # is the terminal lifecycle event of a trace, and it can happen
+        # on any thread — the dispatcher, an evictor, drain_reject —
+        # so the ids ride the future itself
+        self.trace: Optional[obs.TraceContext] = None
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None
@@ -106,6 +111,17 @@ class ServeFuture:
                     f"ServeFuture {self.uuid!r} resolved twice")
             self._result = result
             self._error = error
+            # the trace's terminal event: EVERY resolution path
+            # (success, dispatch failure, eviction, drain) funnels
+            # through _finish, so the enqueue->resolve timeline closes
+            # exactly once per request.  Emitted BEFORE the event sets:
+            # a waiter unblocked by result() must find the resolve
+            # record already in the stream (emit is a non-blocking
+            # queue put — cheap under the lock).
+            attrs = ({"error": type(error).__name__}
+                     if error is not None else {})
+            obs.spans.request_event(self._registry, "resolve", self.trace,
+                                    self.uuid, **attrs)
             self._event.set()
             callbacks, self._callbacks = self._callbacks, []
         for fn in callbacks:
@@ -122,7 +138,7 @@ class ServeRequest:
     """One admitted (or about-to-be-admitted) summarization request."""
 
     __slots__ = ("uuid", "article", "reference", "example", "future",
-                 "deadline", "enqueue_t")
+                 "deadline", "enqueue_t", "trace")
 
     def __init__(self, uuid: str, article: str, reference: str,
                  example: Any, deadline: Optional[Deadline] = None,
@@ -132,6 +148,16 @@ class ServeRequest:
         self.reference = reference
         self.example = example  # data.batching.SummaryExample
         self.future = ServeFuture(uuid, registry=registry)
+        # request-scoped trace root (ISSUE 9): minted at the request's
+        # birth on the SUBMIT thread and carried on the object, so the
+        # dispatch thread and slot engine stamp the same trace_id —
+        # the thread-local span stack could never link them.  A dark
+        # job (obs=False / TS_OBS=0) skips the mint: every consumer
+        # (request_event, span parent) discards the ids anyway, so the
+        # submit hot path shouldn't pay the urandom read for them.
+        reg = registry if registry is not None else obs.registry()
+        self.trace = obs.TraceContext.new() if reg.enabled else None
+        self.future.trace = self.trace
         # the budget runs from ENQUEUE: queue wait spends it, so a
         # request that aged in a deep queue reaches the decoder with
         # less room and degrades (or at worst expires) honestly
@@ -163,6 +189,7 @@ class RequestQueue:
         self._q: "queue_lib.Queue[ServeRequest]" = queue_lib.Queue(
             maxsize=max_depth)
         reg = registry if registry is not None else obs.registry()
+        self._reg = reg
         # under sustained overload there is no point probing the queue
         # per request; a short reset window keeps shedding responsive
         # to recovery while bounding the lock traffic of hot rejection
@@ -198,9 +225,19 @@ class RequestQueue:
             raise ServeClosedError("serving queue is closed")
         if not block and not self._breaker.allow():
             self._c_shed.inc()
+            obs.spans.request_event(self._reg, "shed", req.trace, req.uuid,
+                                    cause="breaker_open")
             raise ServeOverloadError(
                 "request shed: admission breaker open (sustained overload)")
         req.enqueue_t = time.monotonic()
+        # lifecycle root event BEFORE the queue put: the instant the
+        # request becomes visible to the dispatch thread it may emit
+        # admit/slot/resolve, and those must never precede enqueue in
+        # the stream (a Full put turns the trace into enqueue -> shed —
+        # an honest timeline for a request that reached the queue and
+        # bounced)
+        obs.spans.request_event(self._reg, "enqueue", req.trace, req.uuid,
+                                depth=self._q.qsize())
         try:
             if block:
                 self._q.put(req, timeout=timeout)
@@ -210,6 +247,8 @@ class RequestQueue:
             if not block:
                 self._breaker.record_failure()
             self._c_shed.inc()
+            obs.spans.request_event(self._reg, "shed", req.trace, req.uuid,
+                                    cause="queue_full")
             raise ServeOverloadError(
                 f"serve queue full (depth {self.max_depth}); request "
                 f"{req.uuid!r} rejected") from None

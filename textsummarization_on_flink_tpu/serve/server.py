@@ -59,11 +59,14 @@ serve/degraded_total, serve/errors_total.  Chaos: injection point
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Any, List, Optional, Sequence
 
 from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.obs import flightrec
+from textsummarization_on_flink_tpu.obs import http as obs_http
 from textsummarization_on_flink_tpu.config import (
     HParams,
     resolve_refill_chunk,
@@ -154,6 +157,23 @@ class ServingServer:
                                          registry=self._reg)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # failure flight recorder (OBSERVABILITY.md "Flight recorder"):
+        # per-tick/per-dispatch frames ring in memory; the serve-side
+        # triggers (dispatch failure, breaker open, eviction storm) dump
+        # them next to the decode output.  Needs a directory to land in:
+        # the decoder's decode dir when it has one, else the job's log
+        # root; stub wirings with neither run without a recorder.
+        if self._reg.enabled and getattr(hps, "flight_frames", 0) > 0:
+            flight_dir = getattr(decoder, "_decode_dir", None)
+            if flight_dir is None and hps.log_root:
+                flight_dir = os.path.join(hps.log_root,
+                                          hps.exp_name or "exp")
+            if flight_dir:
+                flightrec.install_flight_recorder(
+                    self._reg, flight_dir, capacity=hps.flight_frames)
+        # live exposition plane (/metrics, /healthz, /snapshot, /spans):
+        # off unless TS_OBS_HTTP / HParams(obs_http_port) says otherwise
+        obs_http.maybe_serve(self._reg, hps)
         self._h_queue_time = self._reg.histogram(
             "serve/time_in_queue_seconds")
         self._h_e2e = self._reg.histogram("serve/e2e_latency_seconds")
@@ -192,6 +212,9 @@ class ServingServer:
         if n:
             self._c_errors.inc(n)
         self._thread = None
+        # a stopped server's silence is not a failure: retire the beat
+        # so /healthz reflects the components still running
+        obs_http.retire_heartbeat(self._reg, "serve/dispatch")
 
     def __enter__(self) -> "ServingServer":
         return self.start()
@@ -296,12 +319,21 @@ class ServingServer:
             return Deadline.never()
         return min(bounded, key=lambda d: d.remaining())
 
+    def _beat(self) -> None:
+        # one beat per dispatch-loop iteration; the shared
+        # LOOP_HEARTBEAT_PERIOD carries the jit-compile-tolerance
+        # rationale (obs/http.py) and keeps the trainer's and this
+        # loop's /healthz semantics from drifting
+        obs_http.heartbeat(self._reg, "serve/dispatch",
+                           period=obs_http.LOOP_HEARTBEAT_PERIOD)
+
     def _run(self) -> None:
         if self._mode == "continuous":
             self._run_continuous()
             return
         t_last = time.monotonic()
         while True:
+            self._beat()
             group = self._batcher.next_group()
             if group is None:
                 if self._stop.is_set() and self._queue.empty():
@@ -335,9 +367,12 @@ class ServingServer:
         only' contract at slot granularity."""
         t_last = time.monotonic()
         while True:
+            self._beat()
             try:
                 self._cont.tick()
             except Exception as e:  # tslint: disable=TS005 — every resident future is rejected with the typed cause and counted in serve/errors_total by fail_resident; the loop must outlive any one tick
+                flightrec.trigger(self._reg, "serve_dispatch",
+                                  error=type(e).__name__)
                 n = self._cont.fail_resident(e)
                 log.exception("continuous dispatch tick failed; rejected "
                               "%d resident request(s)", n)
@@ -359,19 +394,30 @@ class ServingServer:
         now = time.monotonic()
         live: List[ServeRequest] = []
         for r in group:
-            self._h_queue_time.observe(now - r.enqueue_t)
+            queue_s = now - r.enqueue_t
+            self._h_queue_time.observe(queue_s)
             if r.deadline.expired():
                 # the ISSUE-6 bugfix, micro-batch side: a request whose
                 # budget died in the queue is resolved typed instead of
                 # burning a dispatch on an answer nobody is waiting for
                 self._c_evictions.inc()
+                obs.spans.request_event(self._reg, "evict", r.trace,
+                                        r.uuid, where="queue")
                 r.future._reject(DeadlineExceededError(
                     f"request {r.uuid!r} deadline expired while queued"))
             else:
+                obs.spans.request_event(
+                    self._reg, "admit", r.trace, r.uuid,
+                    queue_ms=round(queue_s * 1e3, 3))
                 live.append(r)
         group = live
         if not group:
             return
+        # micro-batch flight frame (the per-dispatch analogue of the
+        # continuous per-tick frame), recorded before the dispatch so a
+        # failing batch leaves its own pre-failure frame behind
+        flightrec.record(self._reg, "serve_dispatch", fill=len(group),
+                         queue_depth=self._queue.qsize())
         try:
             with obs.spans.span(self._reg, "serve/dispatch",
                                 fill=len(group)):
@@ -388,6 +434,8 @@ class ServingServer:
             # a failed dispatch fails ITS batch only — each member
             # resolves exactly once with the typed cause; the server
             # lives on to serve the next group
+            flightrec.trigger(self._reg, "serve_dispatch",
+                              error=type(e).__name__)
             self._c_errors.inc(len(group))
             log.exception("serve dispatch failed; rejecting %d request(s)",
                           len(group))
@@ -400,6 +448,9 @@ class ServingServer:
                 self._c_degraded.inc()
             self._h_e2e.observe(done_t - r.enqueue_t)
             self._c_done.inc()
+            obs.spans.request_event(
+                self._reg, "finish", r.trace, r.uuid,
+                degraded=bool(getattr(res, "degraded", False)))
             r.future._resolve(res)
 
 

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.obs import flightrec
+from textsummarization_on_flink_tpu.obs import http as obs_http
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.models import get_family
 from textsummarization_on_flink_tpu.resilience import faultinject
@@ -535,6 +537,23 @@ class Trainer:
         self._c_examples = self._obs.counter("train/examples_total")
         self._c_nan = self._obs.counter("train/nan_watchdog_total")
         self._c_dump_errors = self._obs.counter("train/nan_dump_errors_total")
+        # same gauge instance the DevicePrefetcher writes (get-or-create
+        # by name): read per flushed step into flight-recorder frames
+        self._g_prefetch = self._obs.gauge("train/prefetch_queue_depth")
+        # run-scoped trace root (ISSUE 9): metrics-flush spans carry the
+        # run's trace_id so one training run's spans link in events.jsonl
+        # the way one serve request's do
+        self._trace = (obs.TraceContext.new() if self._obs.enabled
+                       else None)
+        # failure flight recorder: per-step frames ring in memory and
+        # dump to <train_dir>/flight_<reason>.jsonl when the NaN
+        # watchdog / divergence recovery fires (OBSERVABILITY.md)
+        if self._obs.enabled and getattr(hps, "flight_frames", 0) > 0:
+            flightrec.install_flight_recorder(
+                self._obs, self.train_dir, capacity=hps.flight_frames)
+        # live exposition plane (off unless TS_OBS_HTTP /
+        # HParams(obs_http_port) enables it; one server per process)
+        obs_http.maybe_serve(self._obs, hps)
         # resilience (RESILIENCE.md): the fault plan is resolved ONCE so
         # the per-point RNG streams stay deterministic across the run;
         # unarmed jobs hold the null singleton (fire() is `return False`)
@@ -630,6 +649,10 @@ class Trainer:
             return self._train_loop(limit, last_ckpt, profile_dir,
                                     profile_start, profile_stop)
         finally:
+            # a finished (or aborted) run is not a WEDGED run: retire
+            # the loop heartbeat so /healthz doesn't 503 a process that
+            # trained to completion and moved on (e.g. train -> serve)
+            obs_http.retire_heartbeat(self._obs, "train/loop")
             if profile_dir:
                 try:  # finalize a trace left open by an exception/NaN abort
                     jax.profiler.stop_trace()
@@ -715,7 +738,8 @@ class Trainer:
         # dispatch-serialization price the windowing amortizes, so it is
         # measured (train/metrics_fetch_seconds) rather than guessed
         t_fetch = time.perf_counter()
-        with obs.spans.span(self._obs, "train/metrics_flush"):
+        with obs.spans.span(self._obs, "train/metrics_flush",
+                            parent=self._trace, step=pending[0][0]):
             fetched = jax.device_get([m for _, _, m, _ in pending])
         self._m_fetch.observe(time.perf_counter() - t_fetch)
         total = sum(n for _, n, _, _ in pending)
@@ -724,6 +748,7 @@ class Trainer:
             self._m_step_time.observe(step_time)
         log.info("seconds for training step: %.3f (avg over %d)",
                  step_time, total)
+        prefetch_depth = self._g_prefetch.value
         for (step0, n, _, arrays), m in zip(pending, fetched):
             for i in range(n):
                 step = step0 + i
@@ -738,9 +763,18 @@ class Trainer:
                     cl = float(pick(m.coverage_loss))
                     log.info("coverage_loss: %f", cl)
                     scalars["coverage_loss"] = cl
+                # per-step flight frame: what the NaN post-mortem reads
+                # (a finite-or-not loss ships either way — the LAST
+                # frames before a blowup are the interesting ones)
+                flightrec.record(
+                    self._obs, "train_step", step=step, loss=loss,
+                    global_norm=float(pick(m.global_norm)),
+                    step_time=round(step_time, 6),
+                    prefetch_depth=prefetch_depth)
                 if not np.isfinite(loss):
                     self._c_nan.inc()
                     self._dump_nan_batch(step, arrays)
+                    flightrec.trigger(self._obs, "train_nan", step=step)
                     # worst case: the bad step opens a window that only
                     # flushes at >= metrics_every steps, reached in whole
                     # k-step dispatches — so up to metrics_every + k - 2
@@ -791,6 +825,10 @@ class Trainer:
             return True
         if action == "rollback":
             restored = rec.take_rollback()
+            # the post-mortem moment: the frames BEFORE this rollback are
+            # what "what did the last N steps look like?" asks about
+            flightrec.trigger(self._obs, "nan_rollback", step=step,
+                              rollbacks_left=rec.rollbacks_left)
             self.state = restored
             # the LR cut changes the step function: rebuild and drop the
             # multi-step cache (both re-jit; a rollback is rare enough
@@ -827,6 +865,13 @@ class Trainer:
         profile_done = False  # one-shot: never restart a finished trace
         exhausted = False
         while not exhausted:
+            # trainer-loop heartbeat for /healthz (obs/http.py): one beat
+            # per dispatch; 3x the shared period of silence — a wedged
+            # input pipeline, a hung collective — marks the loop
+            # degraded (LOOP_HEARTBEAT_PERIOD carries the
+            # compile/checkpoint-tolerance rationale)
+            obs_http.heartbeat(self._obs, "train/loop",
+                               period=obs_http.LOOP_HEARTBEAT_PERIOD)
             if limit and step >= limit:
                 break
             # k batches per dispatch (steps_per_dispatch), clipped to the
@@ -886,6 +931,7 @@ class Trainer:
                 # offending batch and surface the watchdog error type
                 self._c_nan.inc()
                 self._dump_nan_batch(step, arrays)
+                flightrec.trigger(self._obs, "train_nan", step=step)
                 if self._recovery is not None:
                     # the step never completed, so self.state is still
                     # the pre-dispatch state — skip/rollback from it
@@ -911,6 +957,8 @@ class Trainer:
                 if injected or not finite:
                     self._c_nan.inc()
                     self._dump_nan_batch(step, arrays)
+                    flightrec.trigger(self._obs, "train_nan", step=step,
+                                      injected=bool(injected))
                     # new_state is discarded; self.state (pre-dispatch,
                     # never donated when armed) remains the live params
                     if self._recover(step):
@@ -931,6 +979,8 @@ class Trainer:
                 self.state = new_state
                 if injected:
                     self._c_nan.inc()
+                    flightrec.trigger(self._obs, "train_nan", step=step,
+                                      injected=True)
                     raise NonFiniteLossError(
                         f"injected train.step_nan fault at step {step} "
                         f"(divergence recovery unarmed: nan_skip_steps and "

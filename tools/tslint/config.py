@@ -48,6 +48,14 @@ DEFAULT: Dict[str, Any] = {
                 r"^ContinuousBatcher\.(tick|_refill|_harvest|_evict_expired)$",
                 r"^ServingServer\._run_continuous$",
                 r"^SlotDecodeEngine\.(pack|step|unpack)$",
+                # the telemetry plane's own per-tick/per-step code
+                # (ISSUE 9): frame recording and heartbeats run inside
+                # every hot loop above — a host sync smuggled into THEM
+                # would serialize the loops they observe
+                r"^ContinuousBatcher\._record_frame$",
+                r"^FlightRecorder\.record$",
+                r"^HeartbeatBoard\.beat$",
+                r"^ServeFuture\._finish$",
                 # the decode byte diet's restructured search (ISSUE 7):
                 # the backpointer body and the finalize backtrack are the
                 # per-step/per-retire hot code — one stray host sync (or
