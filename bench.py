@@ -48,7 +48,12 @@ Modes (BENCH_MODE):
                     dispatch engine (BENCH_SERVE_MODE) and
                     `--serve-mix=bimodal` the seeded short/long article
                     mix (BENCH_SERVE_MIX) — the straggler workload the
-                    continuous engine exists for.
+                    continuous engine exists for;
+                    `--serve-tier=beam|greedy|spec|draft`
+                    (BENCH_SERVE_TIER, microbatch only) benches one
+                    quality tier — spec rows carry measured acceptance
+                    rate + the implied expected speedup (SERVING.md
+                    "Quality tiers").
   bytes           — XLA cost-analysis byte accounting for the train
                     step (no execution; CPU-forced like input mode):
                     bytes accessed + intensity for the baseline config
@@ -326,6 +331,12 @@ def _config_fingerprint() -> dict:
         fp["reqs"] = int(os.environ.get("BENCH_SERVE_REQS", "64"))
         fp["concurrency"] = int(
             os.environ.get("BENCH_SERVE_CONCURRENCY", "8"))
+        # quality-tier axis (ISSUE 10): each tier runs a DIFFERENT
+        # compiled decode program (beam vs beam-1 vs spec vs draft) —
+        # rows must never cross-substitute.  Added only when
+        # non-default so pre-existing banked records keep matching.
+        if os.environ.get("BENCH_SERVE_TIER", "beam") != "beam":
+            fp["tier"] = os.environ["BENCH_SERVE_TIER"]
     if mode == "decode":
         # while vs scan vs chunked decode loops differ by ~1.4 ms per
         # dynamic iteration on the tunneled backend — never
@@ -1282,6 +1293,8 @@ def bench_serve() -> None:
     from textsummarization_on_flink_tpu.serve.batcher import resolve_buckets
     from textsummarization_on_flink_tpu.serve.server import ServingServer
 
+    from textsummarization_on_flink_tpu.config import SERVE_TIERS
+
     reqs = int(os.environ.get("BENCH_SERVE_REQS", "64"))
     conc = int(os.environ.get("BENCH_SERVE_CONCURRENCY", "8"))
     batch = int(os.environ.get("BENCH_BATCH", "4"))
@@ -1294,12 +1307,28 @@ def bench_serve() -> None:
         # the requested label
         raise ValueError(
             f"BENCH_SERVE_MIX must be 'buckets' or 'bimodal', got {mix!r}")
+    tier = os.environ.get("BENCH_SERVE_TIER", "beam")
+    if tier not in SERVE_TIERS:
+        raise ValueError(
+            f"BENCH_SERVE_TIER must be one of {SERVE_TIERS}, got {tier!r}")
+    if serve_mode == "continuous" and tier != "beam":
+        raise ValueError(
+            "continuous serving decodes at the beam tier only; drop "
+            "BENCH_SERVE_TIER or use BENCH_SERVE_MODE=microbatch")
     slots = int(os.environ.get("BENCH_SERVE_SLOTS", "0"))
     refill_chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "0"))
     hps = HParams(batch_size=batch, mode="decode", coverage=True,
                   serve_max_wait_ms=wait_ms, serve_mode=serve_mode,
                   serve_slots=slots, serve_refill_chunk=refill_chunk,
                   serve_max_queue=max(256, reqs), **_preset_overrides())
+    if tier in ("spec", "draft"):
+        # the draft model source: the mapped bootstrap for the
+        # transformer family (the real serving recipe), fresh init for
+        # the others (exactness holds either way; acceptance is the
+        # row's evidence, not an assumption)
+        hps = hps.replace(
+            spec_draft="map" if hps.model_family == "transformer"
+            else "fresh")
     hps.validate()
     if hps.model_family == "transformer":
         hps = hps.replace(coverage=False)
@@ -1353,8 +1382,8 @@ def bench_serve() -> None:
                     # warm a SMALLER bucket and leave b's compile in
                     # the timed run)
                     words = [pool[i % len(pool)] for i in range(b)]
-                    server.submit(" ".join(words),
-                                  uuid=f"warm{b}").result(timeout=1200)
+                    server.submit(" ".join(words), uuid=f"warm{b}",
+                                  tier=tier).result(timeout=1200)
             fills0 = (fill_h.count, fill_h.sum)
             occ0 = (occ_h.count, occ_h.sum)
             # counters snapshot AFTER warm-up, like the histograms: the
@@ -1364,6 +1393,9 @@ def bench_serve() -> None:
             evict0 = reg.counter("serve/deadline_evictions_total").value
             shed0 = reg.counter("serve/shed_total").value
             degraded0 = reg.counter("serve/degraded_total").value
+            drafted0 = reg.counter("decode/spec_draft_tokens_total").value
+            accepted0 = reg.counter(
+                "decode/spec_accepted_tokens_total").value
             lat: list = []
             # trace-derived per-request breakdown (ISSUE 9 satellite):
             # TEE the timed phase's lifecycle events into memory (an
@@ -1385,7 +1417,7 @@ def bench_serve() -> None:
             def one(i: int) -> None:
                 t0 = time.perf_counter()
                 server.submit(articles[i % len(articles)], uuid=f"r{i}",
-                              block=True).result(timeout=1200)
+                              block=True, tier=tier).result(timeout=1200)
                 lat.append(time.perf_counter() - t0)
 
             reg.event_sink = _Tee()
@@ -1442,6 +1474,7 @@ def bench_serve() -> None:
             "vs_baseline": 0.0,  # the reference publishes no serving numbers
             "p99_ms": round(pct(lat, 0.99) * 1000, 2),
             "serve_mode": serve_mode,
+            "tier": tier,
             "mix": mix,
             "batch_fill_mean": round(fill_mean, 2),
             "occupancy_mean": round(occupancy, 3),
@@ -1480,9 +1513,38 @@ def bench_serve() -> None:
             "degraded_total": int(
                 reg.counter("serve/degraded_total").value - degraded0),
             "model_family": hps.model_family,
+            "spec_k": int(hps.spec_k),
             "timing": "wall-clock per request, enqueue -> resolved future "
                       "(queue wait + coalescing window included)",
         }
+        if tier == "spec":
+            # measured acceptance -> expected speedup (the BYTE_BUDGET
+            # "spec" evidence trail): acceptance comes from THIS run's
+            # verifier; the draft/full cost ratio is the committed
+            # ceiling, so the published number is conservative
+            from textsummarization_on_flink_tpu.decode.speculative import (
+                expected_speedup,
+            )
+
+            drafted = int(reg.counter(
+                "decode/spec_draft_tokens_total").value - drafted0)
+            accepted = int(reg.counter(
+                "decode/spec_accepted_tokens_total").value - accepted0)
+            accept_rate = (accepted / drafted) if drafted else 0.0
+            rec["draft_tokens"] = drafted
+            rec["accepted_tokens"] = accepted
+            rec["accept_rate"] = round(accept_rate, 4)
+            try:
+                budget_path = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BYTE_BUDGET.json")
+                with open(budget_path) as f:
+                    ratio = json.load(f)["spec"]["max_draft_flops_ratio"][
+                        hps.model_family]
+                rec["expected_speedup_vs_greedy"] = round(
+                    expected_speedup(accept_rate, hps.spec_k, ratio), 3)
+            except (OSError, KeyError, ValueError):
+                pass  # no committed ratio for this family: rate-only row
         rec.update(info)
         rec.update(_obs_extra())
         print(json.dumps(rec))
@@ -1746,6 +1808,9 @@ if __name__ == "__main__":
         elif arg.startswith("--serve-mix="):
             os.environ["BENCH_MODE"] = "serve"
             os.environ["BENCH_SERVE_MIX"] = arg.split("=", 1)[1]
+        elif arg.startswith("--serve-tier="):
+            os.environ["BENCH_MODE"] = "serve"
+            os.environ["BENCH_SERVE_TIER"] = arg.split("=", 1)[1]
     if os.environ.get("TS_BENCH_CHILD") == "1":
         child_main()
     else:

@@ -235,6 +235,14 @@ pair_denominator train_b16 BENCH_MODE=train
 DID_MEASURE=0
 run train_transformer_flash BENCH_MODE=train BENCH_FAMILY=transformer TS_FLASH=on
 pair_denominator train_transformer BENCH_MODE=train BENCH_FAMILY=transformer
+# --- speculative quality tier (ISSUE 10): the spec row carries the
+# measured acceptance rate + implied expected speedup next to its
+# p50/p99; greedy is its same-window comparison baseline (the tier
+# that spec is token-exact with).  Transformer family: the draft is
+# the mapped AAN bootstrap, the real serving recipe.
+DID_MEASURE=0
+run serve_spec_tier      BENCH_MODE=serve BENCH_FAMILY=transformer BENCH_SERVE_TIER=spec BENCH_TIMEOUT=1200
+pair_denominator serve_greedy_tier BENCH_MODE=serve BENCH_FAMILY=transformer BENCH_SERVE_TIER=greedy BENCH_TIMEOUT=1200
 run attention_ab         BENCH_MODE=attention
 run flash_ab             BENCH_MODE=flash
 run input_pipeline       BENCH_MODE=input

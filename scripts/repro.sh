@@ -66,6 +66,14 @@ echo "== serve smoke (CollectionSource -> ServingServer -> CollectionSink)"
 # with row-for-row parity asserted between them
 python scripts/serve_smoke.py
 
+echo "== speculative-tier smoke (draft init -> spec decode -> exactness)"
+# the ISSUE-10 fast path end to end: AAN draft mapped from the full
+# model's own params, draft-then-verify decode through the decoder's
+# tier surface, token exactness vs the greedy tier asserted (the
+# committed FLOPs/state gates live in BYTE_BUDGET.json's spec section,
+# enforced in the suite above)
+python scripts/spec_smoke.py
+
 echo "== live-plane smoke (/metrics + /healthz scrape over a continuous run)"
 # the ISSUE-9 exposition plane end to end: scrape-vs-render_text byte
 # parity, healthz component heartbeats, and one uuid's trace timeline

@@ -97,6 +97,15 @@ DECODE_CONFIGS = {
                           "path": "batch"},
 }
 
+# speculative-tier FLOPs rows (ISSUE 10, PERF.md "Speculative tier"):
+# per-tier FLOPs per emitted token via __graft_entry__.decode_step_flops
+# (beam / greedy / AAN draft, plus the transformer's parallel verify) —
+# the draft-cost side of BYTE_BUDGET.json's spec section at ask scale.
+SPEC_CONFIGS = {
+    "spec_flops_pg": {"env": {}},
+    "spec_flops_transformer": {"env": {"BENCH_FAMILY": "transformer"}},
+}
+
 _BENCH_ENV_VARS = ("BENCH_BATCH", "BENCH_PRESET", "BENCH_FAMILY",
                    "BENCH_UNROLL", "BENCH_REMAT", "BENCH_LOSS_CHUNK",
                    "BENCH_OPT_DTYPE")
@@ -115,14 +124,22 @@ def hps_for(tag: str, bench_mod):
     mapping + bench.bench_train's own construction."""
     from textsummarization_on_flink_tpu.config import HParams
 
-    env = (DECODE_CONFIGS[tag]["env"] if tag in DECODE_CONFIGS
-           else CONFIGS[tag])
+    if tag in DECODE_CONFIGS:
+        env = DECODE_CONFIGS[tag]["env"]
+    elif tag in SPEC_CONFIGS:
+        env = SPEC_CONFIGS[tag]["env"]
+    else:
+        env = CONFIGS[tag]
     saved = {k: os.environ.pop(k, None) for k in _BENCH_ENV_VARS}
     try:
         os.environ.update(env)
         batch = int(os.environ.get("BENCH_BATCH", "16"))
         hps = HParams(batch_size=batch, compute_dtype="bfloat16",
                       **bench_mod._preset_overrides())
+        if tag in SPEC_CONFIGS:
+            # the committed draft recipe: 1 kept layer (BYTE_BUDGET.json
+            # spec.draft_overrides), spec_k from the HParams default
+            return hps.replace(mode="decode", draft_dec_layers=1)
         return hps.replace(mode="decode") if tag in DECODE_CONFIGS else hps
     finally:
         for k, v in saved.items():
@@ -218,6 +235,44 @@ def analyze_decode(tag: str, chip: str, bench_mod):
     }
 
 
+def analyze_spec(tag: str, chip: str, bench_mod):
+    """A spec-tier FLOPs row: per-tier step FLOPs per emitted token
+    (cost-analysis + the closed-form analytic model), the draft/full
+    ratio, and the acceptance->expected-speedup curve the committed
+    BYTE_BUDGET.json spec section models."""
+    from __graft_entry__ import decode_step_flops
+
+    hps = hps_for(tag, bench_mod)
+    peak_tflops, _ = CHIPS[chip]
+    flops = decode_step_flops(hps)
+    tiers = {
+        name: {
+            "flops_per_token": c["flops"],
+            "analytic_flops_per_token": c["analytic_flops"],
+            "state_bytes": c["state_bytes"],
+            "compute_floor_us_per_token": round(
+                c["flops"] / (peak_tflops * 1e12) * 1e6, 4),
+        }
+        for name, c in flops["tiers"].items()
+    }
+    return {
+        "config": tag,
+        "chip": chip,
+        "family": hps.model_family,
+        "spec_k": flops["spec_k"],
+        "draft_dec_layers": hps.draft_dec_layers or hps.dec_layers,
+        "tiers": tiers,
+        "draft_full_flops_ratio": round(flops["draft_full_ratio"], 4),
+        "draft_state_ratio": round(flops["draft_state_ratio"], 4),
+        "verify_flops_per_position": flops["verify_flops_per_position"],
+        "expected_speedup_vs_acceptance": {
+            a: round(s, 4) for a, s in flops["expected_speedup"].items()},
+        "note": "speedup model: one verify invocation ~ one full step "
+                "(bandwidth-bound weight streaming); committed ceilings "
+                "+ kill conditions in BYTE_BUDGET.json spec",
+    }
+
+
 def _cost_of(fn, *args):
     import jax
 
@@ -306,7 +361,8 @@ def main(argv=None):
                     "train_b16_optbf16,train_b16_bytediet,"
                     "train_transformer_losschunk,"
                     "decode_bytes_pg,decode_bytes_pg_slot,"
-                    "decode_bytes_transformer,decode_bytes_transformer_slot")
+                    "decode_bytes_transformer,decode_bytes_transformer_slot,"
+                    "spec_flops_pg,spec_flops_transformer")
     ap.add_argument("--configs", default=default_cfgs)
     ap.add_argument("--chip", default="v5e", choices=sorted(CHIPS))
     ap.add_argument("--json", action="store_true")
@@ -321,16 +377,22 @@ def main(argv=None):
     measured = measured_rows(args.bench)
     out = []
     decode_out = []
+    spec_out = []
     for tag in args.configs.split(","):
         tag = tag.strip()
         if tag in DECODE_CONFIGS:
             print(f"[roofline] compiling {tag} ...", file=sys.stderr)
             decode_out.append(analyze_decode(tag, args.chip, bench_mod))
             continue
+        if tag in SPEC_CONFIGS:
+            print(f"[roofline] compiling {tag} ...", file=sys.stderr)
+            spec_out.append(analyze_spec(tag, args.chip, bench_mod))
+            continue
         if tag not in CONFIGS:
             raise SystemExit(f"unknown config {tag!r}; "
-                             f"choose from {sorted(CONFIGS)} or "
-                             f"{sorted(DECODE_CONFIGS)}")
+                             f"choose from {sorted(CONFIGS)}, "
+                             f"{sorted(DECODE_CONFIGS)}, or "
+                             f"{sorted(SPEC_CONFIGS)}")
         print(f"[roofline] compiling {tag} ...", file=sys.stderr)
         rec = analyze(tag, args.chip, bench_mod, measured.get(tag))
         if args.attribute:
@@ -340,7 +402,7 @@ def main(argv=None):
                                 "bytes": rec["bytes_accessed"]})
         out.append(rec)
     if args.json:
-        for rec in out + decode_out:
+        for rec in out + decode_out + spec_out:
             print(json.dumps(rec))
         return 0
     hdr = (f"{'config':<18} {'bound':<9} {'GFLOP':>8} {'GB':>7} "
@@ -369,6 +431,24 @@ def main(argv=None):
             print(f"{r['config']:<30} {r['path']:<6} "
                   f"{r['bytes_per_token'] / 1e3:>9.1f} {temp:>13} "
                   f"{r['bandwidth_floor_us_per_token']:>13.3f}")
+    if spec_out:
+        print("\nspeculative-tier FLOPs per emitted token "
+              "(committed ceilings in BYTE_BUDGET.json spec):")
+        print(f"{'config':<24} {'tier':<7} {'kFLOP/tok':>10} "
+              f"{'analytic':>9} {'state B':>8}")
+        for r in spec_out:
+            for name in ("beam", "greedy", "draft"):
+                t = r["tiers"][name]
+                print(f"{r['config']:<24} {name:<7} "
+                      f"{t['flops_per_token'] / 1e3:>10.1f} "
+                      f"{t['analytic_flops_per_token'] / 1e3:>9.1f} "
+                      f"{t['state_bytes']:>8}")
+            curve = ", ".join(
+                f"a={a}:{s:.2f}" for a, s in
+                r["expected_speedup_vs_acceptance"].items())
+            print(f"  draft/full ratio {r['draft_full_flops_ratio']:.3f} "
+                  f"(state {r['draft_state_ratio']:.4f}); "
+                  f"expected speedup {curve}")
     by_tag = {r["config"]: r for r in out}
     diet_rows = [(tag, base) for tag, base in _BYTE_DIET_BASELINES.items()
                  if tag in by_tag and base in by_tag]

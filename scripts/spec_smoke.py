@@ -1,0 +1,72 @@
+"""Speculative-tier smoke (ISSUE 10): bootstrap an AAN draft from a
+tiny transformer's own params (the `spec_draft="map"` recipe), run the
+draft-then-verify fast path through the REAL decoder's tier surface,
+and assert token exactness against the greedy tier — the no-hardware
+proof that draft init -> spec decode -> verify works end to end.
+Wired into scripts/repro.sh.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import tempfile  # noqa: E402
+
+import jax  # noqa: E402
+
+from textsummarization_on_flink_tpu import obs  # noqa: E402
+from textsummarization_on_flink_tpu.config import HParams  # noqa: E402
+from textsummarization_on_flink_tpu.data.batching import (  # noqa: E402
+    Batch,
+    SummaryExample,
+)
+from textsummarization_on_flink_tpu.data.vocab import Vocab  # noqa: E402
+from textsummarization_on_flink_tpu.decode.decoder import (  # noqa: E402
+    BeamSearchDecoder,
+)
+from textsummarization_on_flink_tpu.models import get_family  # noqa: E402
+
+
+def main() -> None:
+    vocab = Vocab(words=["article", "reference", ".", "0", "1", "2", "3",
+                         "4", "5", "6", "7"])
+    hps = HParams(mode="decode", batch_size=4, hidden_dim=16, emb_dim=16,
+                  vocab_size=vocab.size(), max_enc_steps=16,
+                  max_dec_steps=8, beam_size=2, min_dec_steps=1,
+                  max_oov_buckets=4, model_family="transformer",
+                  num_heads=2, enc_layers=1, dec_layers=2,
+                  spec_k=3, draft_dec_layers=1, spec_draft="map")
+    hps.validate()
+    params = get_family(hps.model_family).init_params(
+        hps, vocab.size(), jax.random.PRNGKey(0))
+    # the decoder builds the mapped draft itself (spec_draft="map")
+    decoder = BeamSearchDecoder(
+        hps, vocab, batcher=None, params=params,
+        decode_root=tempfile.mkdtemp(prefix="spec_smoke_"))
+    assert decoder.has_draft, "mapped draft bootstrap failed"
+
+    examples = [SummaryExample.build(f"article {i} .", [], vocab, hps,
+                                     uuid=f"uuid-{i}") for i in range(4)]
+    batch = Batch(examples, hps, vocab)
+    greedy = decoder.decode_batch(batch, tier="greedy")
+    spec = decoder.decode_batch(batch, tier="spec")
+    draft = decoder.decode_batch(batch, tier="draft")
+    assert len(spec) == len(greedy) == len(draft) == 4
+    for g, s in zip(greedy, spec):
+        assert g.decoded_words == s.decoded_words, (
+            f"spec tier drifted from greedy for {g.uuid}: "
+            f"{g.decoded_words} vs {s.decoded_words}")
+        assert s.tier == "spec"
+    reg = obs.registry()
+    cycles = int(reg.counter("decode/spec_cycles_total").value)
+    drafted = int(reg.counter("decode/spec_draft_tokens_total").value)
+    accepted = int(reg.counter("decode/spec_accepted_tokens_total").value)
+    rate = accepted / drafted if drafted else 0.0
+    print(f"spec smoke OK: 4 rows token-exact with greedy; "
+          f"{cycles} verify cycle(s), acceptance {accepted}/{drafted} "
+          f"({rate:.0%}); draft tier served {len(draft)} rows")
+
+
+if __name__ == "__main__":
+    main()

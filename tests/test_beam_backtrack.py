@@ -31,10 +31,15 @@ PG_HPS = HParams(batch_size=2, hidden_dim=8, emb_dim=6, vocab_size=24,
                  min_dec_steps=2, max_oov_buckets=4, mode="decode")
 TF_HPS = PG_HPS.replace(model_family="transformer", hidden_dim=8, emb_dim=8,
                         num_heads=2, enc_layers=2, dec_layers=2)
+# the AAN draft family (ISSUE 10) rides the same generic mirror: its
+# beam-adapter parity through while/scan/chunked AND the slot kernels
+# is exactly this module's parametrization
+AAN_HPS = TF_HPS.replace(model_family="avg_attention")
 
 FAMILY_CASES = [
     pytest.param("pointer_generator", PG_HPS, id="pg"),
     pytest.param("transformer", TF_HPS, id="tf"),
+    pytest.param("avg_attention", AAN_HPS, id="aan"),
 ]
 
 

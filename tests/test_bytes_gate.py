@@ -23,8 +23,15 @@ import os
 
 import pytest
 
-from textsummarization_on_flink_tpu.config import HParams
-from __graft_entry__ import decode_step_cost, train_step_comms, train_step_cost
+from textsummarization_on_flink_tpu.config import HParams, derive_draft_hps
+from __graft_entry__ import (
+    _analytic_step_flops,
+    decode_state_bytes,
+    decode_step_cost,
+    decode_step_flops,
+    train_step_comms,
+    train_step_cost,
+)
 
 BUDGET_PATH = os.path.join(os.path.dirname(__file__), "..",
                            "BYTE_BUDGET.json")
@@ -211,6 +218,117 @@ def test_decode_peak_temp_floors_hold(budget, decode_measured, family, kind):
         f"{family}/{kind}: decode peak-temp reduction vs the pre-PR "
         f"baseline fell to {reduction:.1%} (committed floor {floor:.1%}) — "
         f"the trajectory buffers are materializing again")
+
+
+# --------------------------------------------------------------------------
+# Speculative-tier gate (ISSUE 10; PERF.md "Speculative tier")
+# --------------------------------------------------------------------------
+#
+# The committed `spec` section pins the draft tier's per-token cost
+# against the full model (FLOPs ratio ceilings from cost_analysis), the
+# AAN family's O(1)-in-history resident state, and the honesty of the
+# committed acceptance-rate -> expected-speedup curve (recomputed from
+# the bandwidth-model formula at the committed reference-scale analytic
+# ratio).  See BYTE_BUDGET.json spec._comment for the ceilings' story
+# and the stated kill condition.
+
+
+def _spec_hps(budget, family: str) -> HParams:
+    gs = dict(budget["gate_scale"][family])
+    gs.update(budget["decode"]["gate_scale_overrides"])
+    hps = HParams(**gs).replace(spec_k=int(budget["spec"]["spec_k"]),
+                                **budget["spec"]["draft_overrides"])
+    hps.validate()
+    return hps
+
+
+@pytest.fixture(scope="module")
+def spec_measured(budget):
+    """One decode_step_flops call per family (~4 small step compiles
+    each; the persistent compile cache makes re-runs near-free)."""
+    return {family: decode_step_flops(_spec_hps(budget, family))
+            for family in ("pointer_generator", "transformer")}
+
+
+@pytest.mark.parametrize("family", ["pointer_generator", "transformer"])
+def test_spec_draft_flops_ratio_within_ceiling(budget, spec_measured,
+                                               family):
+    ceiling = budget["spec"]["max_draft_flops_ratio"][family]
+    got = spec_measured[family]["draft_full_ratio"]
+    assert got <= ceiling, (
+        f"{family}: draft/full decode-step FLOPs ratio rose to "
+        f"{got:.3f} (committed ceiling {ceiling}) — the draft tier "
+        f"stopped being cheap (see BYTE_BUDGET.json spec._comment)")
+
+
+@pytest.mark.parametrize("family", ["pointer_generator", "transformer"])
+def test_spec_draft_state_ratio_within_ceiling(budget, spec_measured,
+                                               family):
+    ceiling = budget["spec"]["max_draft_state_ratio"][family]
+    got = spec_measured[family]["draft_state_ratio"]
+    assert got <= ceiling, (
+        f"{family}: draft/full resident decode-state ratio rose to "
+        f"{got:.4f} (committed ceiling {ceiling}) — the AAN slot-cost "
+        f"advantage eroded")
+
+
+def test_spec_draft_state_is_o1_in_history(budget):
+    """THE AAN claim: the draft's resident decode state does not grow
+    with max_dec_steps (the transformer's KV cache does — sanity-check
+    that the measurement isn't vacuous)."""
+    hps = _spec_hps(budget, "transformer")
+    draft = derive_draft_hps(hps).replace(beam_size=1, mode="decode")
+    short = decode_state_bytes(draft)
+    long_ = decode_state_bytes(draft.replace(max_dec_steps=4 * hps.max_dec_steps))
+    assert short == long_, (
+        f"AAN draft decode state grew with max_dec_steps "
+        f"({short} -> {long_} bytes): the O(1)-in-history property is "
+        f"gone — a history-sized buffer crept into the adapter state")
+    full_short = decode_state_bytes(hps.replace(beam_size=1))
+    full_long = decode_state_bytes(
+        hps.replace(beam_size=1, max_dec_steps=4 * hps.max_dec_steps))
+    assert full_long > full_short  # the contrast that makes O(1) a win
+
+
+def test_spec_expected_speedup_curve_is_honest(budget):
+    """The committed acceptance->speedup curve must equal the model
+    formula evaluated at the committed REFERENCE-scale analytic
+    draft/full ratio, and that ratio must still be reproduced by the
+    analytic step-FLOPs model (no compile — instant)."""
+    from textsummarization_on_flink_tpu.decode.speculative import (
+        expected_speedup,
+    )
+
+    spec = budget["spec"]
+    k = int(spec["spec_k"])
+    ref = HParams(model_family="transformer",
+                  **spec["draft_overrides"])
+    got_ratio = (_analytic_step_flops(derive_draft_hps(ref))
+                 / _analytic_step_flops(ref))
+    want_ratio = spec["ref_analytic_ratio"]["transformer"]
+    assert abs(got_ratio - want_ratio) < 0.005, (
+        f"reference-scale analytic draft/full ratio drifted to "
+        f"{got_ratio:.4f} (committed {want_ratio}) — re-baseline the "
+        f"spec section (and PERF.md) or fix the regression")
+    for alpha, want in spec["expected_speedup"]["transformer"].items():
+        recomputed = expected_speedup(float(alpha), k, want_ratio)
+        assert abs(recomputed - want) / want < 0.02, (
+            f"committed expected_speedup[{alpha}]={want} no longer "
+            f"matches the formula ({recomputed:.4f}) — the curve and "
+            f"the model drifted apart")
+
+
+def test_spec_verify_scores_positions_cheaper_than_steps(budget,
+                                                         spec_measured):
+    """The 'one fat step' claim: the parallel verify's per-position
+    FLOPs must not exceed the incremental greedy step's (it amortizes
+    the cache scatter and shares one pass)."""
+    m = spec_measured["transformer"]
+    assert m["verify_flops_per_position"] is not None
+    assert m["verify_flops_per_position"] <= m["tiers"]["greedy"]["flops"], (
+        f"parallel verify costs {m['verify_flops_per_position']:.0f} "
+        f"FLOPs/position vs {m['tiers']['greedy']['flops']:.0f} for an "
+        f"incremental step — the batched pass lost its advantage")
 
 
 # --------------------------------------------------------------------------

@@ -115,24 +115,35 @@ class StubEngine:
 
 class StubDecoder:
     """decode_batch-compatible stub: optional per-batch delay, results
-    echo the batch's real rows (one per real_mask=True slot)."""
+    echo the batch's real rows (one per real_mask=True slot).  Mirrors
+    the real decoder's tier surface (should_degrade / has_draft /
+    decode_batch(tier=)) so the server's per-request re-tiering is
+    testable without jax."""
 
-    def __init__(self, delay: float = 0.0, degrade_under: float = 0.0):
+    def __init__(self, delay: float = 0.0, degrade_under: float = 0.0,
+                 has_draft: bool = False):
         self.delay = delay
         self.degrade_under = degrade_under
+        self.has_draft = has_draft
         self.batches = []
+        self.tiers = []  # tier of each dispatched batch, in order
         self.reload_calls = 0
 
-    def decode_batch(self, batch, deadline=None):
-        time.sleep(self.delay)
-        self.batches.append(batch)
-        degraded = bool(
+    def should_degrade(self, deadline):
+        return bool(
             self.degrade_under and deadline is not None and deadline.bounded
             and deadline.remaining() < self.degrade_under)
+
+    def decode_batch(self, batch, deadline=None, tier=None):
+        time.sleep(self.delay)
+        self.batches.append(batch)
+        self.tiers.append(tier)
+        degraded = tier is None and self.should_degrade(deadline)
         return [DecodedResult(
                     uuid=batch.uuids[b], article=batch.original_articles[b],
                     decoded_words=["ok", "."], reference=batch.references[b],
-                    abstract_sents=[], degraded=degraded)
+                    abstract_sents=[], degraded=degraded,
+                    tier=tier or "beam")
                 for b in range(len(batch.uuids)) if batch.real_mask[b]]
 
     def maybe_reload_checkpoint(self, last):
@@ -404,6 +415,69 @@ class TestServingServerStub:
             res = server.submit("the cat .", uuid="d0").result(timeout=30)
         assert res.degraded
         assert _isolated_obs.counter("serve/degraded_total").value == 1
+        assert _isolated_obs.counter(
+            "serve/tier_degraded_beam_total").value == 1
+
+    def test_sharded_decoder_rejects_non_beam_tiers_at_submit(
+            self, _isolated_obs):
+        """A mesh decoder's search is jit-built once for the plan: any
+        non-beam tier must fail synchronously at submit, not
+        asynchronously at dispatch (burning an error + flight dump)."""
+        dec = StubDecoder(has_draft=True)
+        dec.sharded = True
+        server = ServingServer(tiny_hps(), make_vocab(), decoder=dec,
+                               registry=_isolated_obs)
+        with server:
+            with pytest.raises(ValueError, match="beam tier only"):
+                server.submit("the cat .", tier="greedy")
+            with pytest.raises(ValueError, match="beam tier only"):
+                server.submit("the cat .", tier="spec")
+            assert server.submit("the cat .", uuid="b0",
+                                 tier="beam").result(timeout=30).uuid == "b0"
+
+    def test_degradation_is_per_request_not_per_batch(self, _isolated_obs):
+        """The ISSUE-10 satellite fix: one tight-deadline member no
+        longer drags its batchmates down to greedy — the group splits
+        into per-tier sub-dispatches and only the pressed request
+        degrades (counted per request AND per requested tier)."""
+
+        class AlternatingDecoder(StubDecoder):
+            # per-REQUEST predicate: degrade every second ask (the
+            # server consults it once per group member)
+            def __init__(self):
+                super().__init__()
+                self.asks = 0
+                self.has_draft = False
+
+            def should_degrade(self, deadline):
+                self.asks += 1
+                return self.asks % 2 == 0
+
+        dec = AlternatingDecoder()
+        hps, vocab = tiny_hps(serve_max_wait_ms=200.0,
+                              decode_deadline_secs=30.0), make_vocab()
+        server = ServingServer(hps, vocab, decoder=dec,
+                               registry=_isolated_obs)
+        server.start()
+        # fill one coalescing window with 4 requests BEFORE dispatch
+        futs = [server.submit("the cat .", uuid=f"m{i}") for i in range(4)]
+        results = {f.result(timeout=30).uuid: f.result(timeout=30)
+                   for f in futs}
+        server.stop()
+        degraded = sorted(u for u, r in results.items() if r.degraded)
+        kept = sorted(u for u, r in results.items() if not r.degraded)
+        assert len(degraded) == 2 and len(kept) == 2, results
+        # the mixed group split into one beam and one greedy dispatch
+        assert sorted(t for t in dec.tiers if t) == ["beam", "greedy"]
+        by_tier = {t: b for t, b in zip(dec.tiers, dec.batches)}
+        greedy_real = [u for u, m in zip(by_tier["greedy"].uuids,
+                                         by_tier["greedy"].real_mask) if m]
+        assert sorted(greedy_real) == degraded
+        assert _isolated_obs.counter("serve/degraded_total").value == 2
+        assert _isolated_obs.counter(
+            "serve/tier_degraded_beam_total").value == 2
+        assert _isolated_obs.counter("serve/tier_beam_total").value == 2
+        assert _isolated_obs.counter("serve/tier_greedy_total").value == 2
 
     def test_expired_in_queue_evicted_typed_not_dispatched(
             self, _isolated_obs):

@@ -236,6 +236,37 @@ class HParams:
     # pinned by test.  The pointer-generator family has no KV cache and
     # ignores this flag.
     decode_cache_dtype: str = "float32"
+    # ---- speculative decode tier (SERVING.md "Quality tiers"; ISSUE 10) ----
+    # Draft tokens proposed per verify cycle: the draft model (AAN
+    # family) proposes spec_k tokens greedily, the full model scores all
+    # spec_k+1 positions in one batched step and accepts the longest
+    # agreeing prefix plus its own correction token — output token-exact
+    # with full-model greedy decode by construction.
+    spec_k: int = 4
+    # Draft-model source for the spec/draft tiers: "" = no draft
+    # configured (spec/draft tier requests are rejected typed at
+    # submit); "map" = bootstrap the AAN draft from the full model's own
+    # checkpoint (transformer family only — models/avg_attention.
+    # init_from_transformer; re-mapped on every checkpoint hot-swap);
+    # "fresh" = random init (tests/smokes; exactness holds, acceptance
+    # is near zero).  Separately trained drafts inject params directly
+    # (BeamSearchDecoder(draft_params=...)).
+    spec_draft: str = ""
+    # Decoder layers the draft keeps (evenly strided over the full
+    # model's; 0 = all of them).  Fewer layers = cheaper draft steps =
+    # lower FLOPs/token ratio in the spec gate (BYTE_BUDGET.json
+    # "spec"), at the price of acceptance rate.
+    draft_dec_layers: int = 0
+    # Quality tier a request gets when it names none (serve/server.py
+    # submit(tier=...)): beam (full search) > greedy (beam_size=1,
+    # token-exact with spec) > spec (draft-then-verify fast path) >
+    # draft (AAN greedy, no verify — gist quality).
+    serve_default_tier: str = "beam"
+    # Deadline-pressure degradation target (the beam->greedy ladder
+    # generalized): a beam request whose remaining budget cannot cover
+    # the observed full-beam latency is re-tiered HERE instead (and a
+    # spec request to "draft"), per REQUEST, not per batch.
+    serve_degrade_tier: str = "greedy"
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score
@@ -356,13 +387,32 @@ class HParams:
         if self.model_family not in FAMILIES:
             raise ValueError(f"unknown model_family {self.model_family!r}; "
                              f"expected one of {FAMILIES}")
-        if self.model_family == "transformer":
+        if self.model_family in ("transformer", "avg_attention"):
             if self.hidden_dim % self.num_heads != 0:
                 raise ValueError(
                     f"num_heads={self.num_heads} must divide "
                     f"hidden_dim={self.hidden_dim}")
             if self.enc_layers < 1 or self.dec_layers < 1:
                 raise ValueError("enc_layers/dec_layers must be >= 1")
+        if self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
+        if self.spec_draft not in ("", "map", "fresh"):
+            raise ValueError(
+                f"spec_draft must be ''|'map'|'fresh', got "
+                f"{self.spec_draft!r}")
+        if not 0 <= self.draft_dec_layers <= self.dec_layers:
+            raise ValueError(
+                f"draft_dec_layers must be in [0, dec_layers="
+                f"{self.dec_layers}], got {self.draft_dec_layers}")
+        if self.serve_default_tier not in SERVE_TIERS:
+            raise ValueError(
+                f"serve_default_tier must be one of {SERVE_TIERS}, got "
+                f"{self.serve_default_tier!r}")
+        if (self.serve_degrade_tier not in SERVE_TIERS
+                or self.serve_degrade_tier == "beam"):
+            raise ValueError(
+                f"serve_degrade_tier must be a tier BELOW beam "
+                f"({SERVE_TIERS[1:]}), got {self.serve_degrade_tier!r}")
         if self.sp_attention not in ("", "ring", "ulysses"):
             raise ValueError(
                 f"sp_attention must be '', 'ring', or 'ulysses', got "
@@ -448,6 +498,25 @@ class HParams:
             from textsummarization_on_flink_tpu.resilience import faultinject
 
             faultinject.parse(self.faults)
+
+
+#: Per-request serving quality tiers, costliest first (SERVING.md
+#: "Quality tiers"; ISSUE 10).  Dependency-light single source: the
+#: serve layer validates request tiers against this and the decoder
+#: dispatches on it.
+SERVE_TIERS = ("beam", "greedy", "spec", "draft")
+
+
+def derive_draft_hps(hps: "HParams") -> "HParams":
+    """The draft model's HParams, derived from the full model's: the
+    avg_attention family at the same hidden width (the checkpoint
+    mapping requires it) with ``draft_dec_layers`` decoder layers
+    (0 = the full model's count).  The ONE resolver — the decoder,
+    the spec engine, the FLOPs gate, and bench all derive through
+    here so no two components can disagree about the draft's shape."""
+    return hps.replace(
+        model_family="avg_attention",
+        dec_layers=hps.draft_dec_layers or hps.dec_layers)
 
 
 def parse_bucket_spec(spec: str, max_enc_steps: int) -> "List[int]":

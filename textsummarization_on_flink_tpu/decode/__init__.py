@@ -1,2 +1,3 @@
 from textsummarization_on_flink_tpu.decode import beam_search  # noqa: F401
 from textsummarization_on_flink_tpu.decode import decoder  # noqa: F401
+from textsummarization_on_flink_tpu.decode import speculative  # noqa: F401

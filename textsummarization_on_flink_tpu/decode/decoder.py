@@ -34,7 +34,11 @@ import numpy as np
 
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
-from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.config import (
+    SERVE_TIERS,
+    HParams,
+    derive_draft_hps,
+)
 from textsummarization_on_flink_tpu.data import oov as oov_lib
 from textsummarization_on_flink_tpu.data.batching import Batch
 from textsummarization_on_flink_tpu.data.vocab import STOP_DECODING, Vocab
@@ -86,7 +90,7 @@ class DecodedResult:
                  reference: str, abstract_sents: List[str],
                  attn_dists: Optional[np.ndarray] = None,
                  p_gens: Optional[np.ndarray] = None,
-                 degraded: bool = False):
+                 degraded: bool = False, tier: str = "beam"):
         self.uuid = uuid
         self.article = article
         self.decoded_words = decoded_words
@@ -97,6 +101,9 @@ class DecodedResult:
         # True when the decode deadline forced beam search down to greedy
         # (RESILIENCE.md graceful degradation; hps.decode_deadline_secs)
         self.degraded = degraded
+        # the quality tier that produced this result (SERVING.md
+        # "Quality tiers": beam|greedy|spec|draft)
+        self.tier = tier
 
     @property
     def decoded_sents(self) -> List[str]:
@@ -124,7 +131,8 @@ class BeamSearchDecoder:
                  params: Optional[Any] = None,
                  train_dir: Optional[str] = None,
                  decode_root: Optional[str] = None,
-                 max_ckpt_retries: Optional[int] = None):
+                 max_ckpt_retries: Optional[int] = None,
+                 draft_params: Optional[Any] = None):
         if params is None and train_dir is None:
             raise ValueError("need params or train_dir")
         self._hps = hps
@@ -163,9 +171,27 @@ class BeamSearchDecoder:
         # to minutes); recording it would lock every later request into
         # greedy, so the EMA only starts at the second full-beam dispatch
         self._beam_warm = False
+        # ---- speculative tier (SERVING.md "Quality tiers"; ISSUE 10) ----
+        # draft params ride the SAME lock as the full pair: with
+        # spec_draft="map" a checkpoint hot-swap re-derives the draft,
+        # and a spec dispatch must never pair old draft with new full
+        self._draft_params = draft_params
+        self._h_accept = self._obs.histogram(
+            "decode/spec_accept_len",
+            buckets=[float(i) for i in range(0, hps.spec_k + 1)])
+        self._c_spec_cycles = self._obs.counter("decode/spec_cycles_total")
+        self._c_spec_drafted = self._obs.counter(
+            "decode/spec_draft_tokens_total")
+        self._c_spec_accepted = self._obs.counter(
+            "decode/spec_accepted_tokens_total")
         self._params = params
         if params is None:
             self._load_params()
+        if self._draft_params is None and hps.spec_draft:
+            from textsummarization_on_flink_tpu.models import avg_attention
+
+            self._draft_params = avg_attention.make_draft_params(
+                hps, self._params, seed=hps.seed)
 
         self._sharded_search = None
         self._mesh_plan = None
@@ -209,9 +235,21 @@ class BeamSearchDecoder:
         path, flat = ckpt_lib.load_ckpt(self._train_dir,
                                         max_retries=self._max_ckpt_retries)
         state = ckpt_lib.arrays_to_state(flat)
+        draft = None
+        if self._hps.spec_draft == "map":
+            # the mapped draft is a VIEW of the full checkpoint: derive
+            # it from the same params the swap installs (outside the
+            # lock, like the load), so spec dispatches never pair a
+            # fresh full model with a stale draft
+            from textsummarization_on_flink_tpu.models import avg_attention
+
+            draft = avg_attention.make_draft_params(
+                self._hps, state.params, seed=self._hps.seed)
         with self._params_lock:
             self._params = state.params
             self._ckpt_path = path
+            if draft is not None:
+                self._draft_params = draft
         log.info("decoder loaded checkpoint %s", path)
 
     def maybe_reload_checkpoint(self, last_load: float) -> float:
@@ -242,9 +280,11 @@ class BeamSearchDecoder:
         return time.monotonic()
 
     # -- decoding --
-    def _should_degrade(self, deadline: Deadline) -> bool:
+    def should_degrade(self, deadline: Deadline) -> bool:
         """True when the remaining request budget cannot cover a
-        full-beam dispatch (RESILIENCE.md degradation contract).
+        full-beam dispatch (RESILIENCE.md degradation contract) — the
+        serve layer's per-REQUEST re-tiering predicate (SERVING.md
+        "Quality tiers").
 
         Requires a latency estimate from a completed full-beam dispatch
         AFTER the compile-inclusive first one — early requests are never
@@ -257,9 +297,31 @@ class BeamSearchDecoder:
                 and self._beam_secs is not None
                 and deadline.remaining() < self._beam_secs)
 
+    _should_degrade = should_degrade  # historical internal name
+
+    @property
+    def has_draft(self) -> bool:
+        """Whether the spec/draft tiers are servable (a draft model is
+        configured — mapped, fresh, or injected)."""
+        return self._draft_params is not None
+
+    @property
+    def sharded(self) -> bool:
+        """True on a dp/tp mesh: the sharded search is jit-built once
+        for the mesh plan, so only the beam tier is servable (the serve
+        layer rejects other tiers at submit)."""
+        return self._sharded_search is not None
+
+    def _spec_snapshot(self) -> Tuple[Any, Any]:
+        """Atomic (full params, draft params) read — the spec tier's
+        analogue of ``_params_snapshot`` (a hot-swap replaces both under
+        the same lock, so a dispatch never pairs mismatched models)."""
+        with self._params_lock:
+            return self._params, self._draft_params
+
     def decode_batch(self, batch: Batch,
                      deadline: Optional[Deadline] = None,
-                     ) -> List[DecodedResult]:
+                     tier: Optional[str] = None) -> List[DecodedResult]:
         """One device dispatch for the whole batch; returns one result per
         REAL input row (``batch.real_mask``).  Padding rows — beam
         repetition in decode 'repeat' mode (batcher.py:344-347) and
@@ -272,14 +334,30 @@ class BeamSearchDecoder:
         degrade).  When the budget is short of the full-beam latency
         estimate the dispatch degrades to greedy (beam_size=1); results
         are tagged ``degraded=True`` and counted in
-        ``resilience/decode_degraded_total``."""
+        ``resilience/decode_degraded_total``.
+
+        Quality tiers (SERVING.md "Quality tiers"; ISSUE 10): an
+        explicit ``tier`` (beam|greedy|spec|draft) dispatches exactly
+        that tier — the serve layer already made the per-request
+        degradation decision, so the internal deadline ladder is
+        skipped.  ``tier=None`` keeps the historical behavior (beam,
+        degrading to greedy under deadline pressure)."""
         if deadline is None:
             deadline = Deadline.after(
                 getattr(self._hps, "decode_deadline_secs", 0.0))
-        degraded = self._should_degrade(deadline)
+        explicit = tier is not None
+        if explicit:
+            if tier not in SERVE_TIERS:
+                raise ValueError(
+                    f"tier must be one of {SERVE_TIERS}, got {tier!r}")
+            degraded = False
+            eff_tier = tier
+        else:
+            degraded = self.should_degrade(deadline)
+            eff_tier = "greedy" if degraded else "beam"
         t0 = time.perf_counter()
-        with obs.spans.span(self._obs, "decode/batch"):
-            results = self._decode_batch_inner(batch, degraded=degraded)
+        with obs.spans.span(self._obs, "decode/batch", tier=eff_tier):
+            results = self._decode_batch_inner(batch, tier=eff_tier)
         dt = time.perf_counter() - t0
         if degraded:
             for res in results:
@@ -289,14 +367,16 @@ class BeamSearchDecoder:
                         "(%.3fs remaining < %.3fs est); degraded %d "
                         "result(s) to greedy", deadline.remaining(),
                         self._beam_secs, len(results))
-        elif not self._beam_warm:
-            self._beam_warm = True  # compile-inclusive sample: discard
-        else:
-            # EMA of full-beam dispatch latency (greedy dispatches and
-            # compile times must not poison the estimate)
-            self._beam_secs = (dt if self._beam_secs is None
-                               else 0.7 * self._beam_secs + 0.3 * dt)
-            self._g_beam_est.set(self._beam_secs)
+        elif eff_tier == "beam":
+            if not self._beam_warm:
+                self._beam_warm = True  # compile-inclusive sample: discard
+            else:
+                # EMA of full-beam dispatch latency (greedy/spec/draft
+                # dispatches and compile times must not poison the
+                # estimate the degradation ladder keys on)
+                self._beam_secs = (dt if self._beam_secs is None
+                                   else 0.7 * self._beam_secs + 0.3 * dt)
+                self._g_beam_est.set(self._beam_secs)
         self._c_busy.inc(dt)
         # requests in a batch share one dispatch: the batch wall time IS
         # each request's observed latency
@@ -308,11 +388,16 @@ class BeamSearchDecoder:
         return results
 
     def _decode_batch_inner(self, batch: Batch,
-                            degraded: bool = False) -> List[DecodedResult]:
+                            tier: str = "beam") -> List[DecodedResult]:
         # one atomic params read per dispatch: a checkpoint hot-swap
         # landing mid-batch affects the NEXT dispatch, never this one
         params, _ = self._params_snapshot()
         if self._sharded_search is not None:
+            if tier != "beam":
+                raise ValueError(
+                    f"sharded (mesh) serving supports the beam tier only "
+                    f"(the search is jit-built once for the mesh plan); "
+                    f"got tier={tier!r}")
             from textsummarization_on_flink_tpu.parallel import mesh as mesh_lib
 
             enc_only = {k: v for k, v in batch.as_arrays().items()
@@ -321,8 +406,38 @@ class BeamSearchDecoder:
                 params, mesh_lib.shard_batch(self._mesh_plan, enc_only))
             out = beam_search.BeamSearchOutput(
                 *[np.asarray(x) for x in raw])
+        elif tier == "spec":
+            from textsummarization_on_flink_tpu.decode import speculative
+
+            full, draft = self._spec_snapshot()
+            if draft is None:
+                raise ValueError(
+                    "spec tier needs a draft model: set hps.spec_draft "
+                    "('map'/'fresh') or pass draft_params=")
+            out = speculative.run_spec_decode(full, draft, self._hps,
+                                              batch.as_arrays())
+            real = np.asarray(batch.real_mask, dtype=bool)
+            self._c_spec_cycles.inc(int(out.cycles[real].sum()))
+            self._c_spec_drafted.inc(int(out.drafted[real].sum()))
+            self._c_spec_accepted.inc(int(out.accepted[real].sum()))
+            # accept_hist already holds per-length cycle counts: fold
+            # the batch once and record O(spec_k) weighted observes,
+            # not one lock acquisition per verify cycle
+            per_len = out.accept_hist[real].sum(axis=0)
+            for a, count in enumerate(per_len):
+                self._h_accept.observe(float(a), n=int(count))
+        elif tier == "draft":
+            _, draft = self._spec_snapshot()
+            if draft is None:
+                raise ValueError(
+                    "draft tier needs a draft model: set hps.spec_draft "
+                    "('map'/'fresh') or pass draft_params=")
+            dhps = derive_draft_hps(self._hps).replace(beam_size=1,
+                                                       mode="decode")
+            out = beam_search.run_beam_search(draft, dhps,
+                                              batch.as_arrays())
         else:
-            hps = (self._hps.replace(beam_size=1) if degraded
+            hps = (self._hps.replace(beam_size=1) if tier == "greedy"
                    else self._hps)
             out = beam_search.run_beam_search(params, hps,
                                               batch.as_arrays())
@@ -336,13 +451,14 @@ class BeamSearchDecoder:
                 article=batch.original_articles[b],
                 reference=batch.references[b],
                 abstract_sents=batch.original_abstracts_sents[b],
-                art_oovs=batch.art_oovs[b]))
+                art_oovs=batch.art_oovs[b], tier=tier))
         return results
 
     def _make_result(self, tokens, length: int, attn_dists, p_gens, *,
                      uuid: str, article: str, reference: str,
                      abstract_sents: List[str],
-                     art_oovs: List[str]) -> DecodedResult:
+                     art_oovs: List[str], tier: str = "beam",
+                     ) -> DecodedResult:
         """One article's raw beam output -> DecodedResult: START strip,
         id->word mapping through the article's OOVs, [STOP] truncation
         (decode.py:112-118).  Shared by the batch path and the slot
@@ -362,7 +478,8 @@ class BeamSearchDecoder:
             reference=reference,
             abstract_sents=abstract_sents,
             attn_dists=attn_dists[: max(len(decoded_words), 1)],
-            p_gens=p_gens[: max(len(decoded_words), 1)])
+            p_gens=p_gens[: max(len(decoded_words), 1)],
+            tier=tier)
 
     def slot_engine(self, slots: int, chunk: int) -> "SlotDecodeEngine":
         """The continuous-batching engine over this decoder's params

@@ -1,0 +1,354 @@
+"""Speculative decode: draft-then-verify fast path (ISSUE 10 tentpole).
+
+A cheap DRAFT model (the avg_attention family, whose decode step is O(1)
+in history) proposes ``spec_k`` tokens greedily; the FULL model scores
+all ``spec_k + 1`` positions in ONE teacher-forced batched step and the
+longest draft prefix agreeing with the full model's own greedy choices
+is accepted, plus the full model's correction token at the first
+disagreement — so every cycle emits at least one token and the emitted
+stream is **token-exact with full-model greedy decode by construction**:
+each emitted token is either the full model's argmax at its position
+(the correction) or a draft token that EQUALS the full model's argmax
+there (the acceptance test).  "Greedy decode" here is exactly the
+serving ladder's greedy tier — ``beam_size=1`` beam search, whose
+candidate triage degenerates to argmax with the same
+discard-early-STOP policy ``_greedy_choice`` implements (pinned by the
+tier-1 exactness tests for both families).
+
+The whole per-article search — draft proposal, verify, acceptance,
+commit — runs inside one jitted ``lax.while_loop`` with the accept
+length TRACED (the same compile discipline as ``step_slots_jit``):
+after the one warmup compile, NO acceptance pattern, article content,
+or draft quality triggers a recompile (pinned by test).
+
+Verify paths per full-model family:
+
+  * transformer — ``transformer.spec_verify``: one PARALLEL decoder
+    pass scores all spec_k+1 positions against the incremental KV
+    cache (the "fewer, fatter steps" restructuring FastSeq-style
+    serving wins come from, PAPERS.md): the expensive model streams its
+    weights once per CYCLE instead of once per token, which on a
+    bandwidth-bound decode step is the speedup lever the
+    BYTE_BUDGET.json ``spec`` gate models.  The cache is append-only;
+    acceptance never rolls it back — the committed step counter masks
+    rejected positions and the next block overwrites them.
+  * any other family (LSTM pointer-generator, avg_attention) — a
+    teacher-forced ``lax.scan`` of the family's OWN beam-adapter step
+    (K=1): still one dispatch per cycle, bitwise the greedy step (an
+    LSTM's state is inherently sequential, so there is no parallel
+    form; the win is dispatch restructuring, not FLOPs — stated in
+    PERF.md).
+
+Draft proposal runs ``spec_k + 1`` draft steps per cycle (one extra so
+the accept-all case's resync state exists without a traced branch);
+after acceptance the draft state re-anchors to the stacked proposal
+state at the emitted length and the correction token becomes the next
+cycle's first input.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from textsummarization_on_flink_tpu.config import HParams, derive_draft_hps
+from textsummarization_on_flink_tpu.data.vocab import START_ID, STOP_ID, UNK_ID
+from textsummarization_on_flink_tpu.decode.beam_search import NEG
+from textsummarization_on_flink_tpu.models import get_family
+
+Array = jax.Array
+
+
+class SpecDecodeOutput(NamedTuple):
+    """Batch output: the BeamSearchOutput field set (so the decoder's
+    ``_make_result`` consumes it unchanged) plus per-article speculative
+    telemetry."""
+
+    tokens: Array  # [B, T_dec+1] extended-vocab ids, [0]=START
+    length: Array  # [B] token count including START
+    avg_log_prob: Array  # [B]
+    attn_dists: Array  # [B, T_dec, T_enc]
+    p_gens: Array  # [B, T_dec]
+    cycles: Array  # [B] draft-verify rounds run
+    drafted: Array  # [B] draft tokens proposed (cycles * spec_k)
+    accepted: Array  # [B] draft tokens accepted by the verifier
+    accept_hist: Array  # [B, spec_k+1] count of cycles per accept length
+
+
+class _SpecCarry(NamedTuple):
+    """Per-article loop state.  ``tokens``/``attn``/``pgens`` carry a
+    scratch row at index T that truncated writes land in (same trick as
+    the beam search's scratch column)."""
+
+    t: Array  # scalar int32: committed generated-token count
+    last: Array  # scalar int32: last committed token (raw extended id)
+    done: Array  # scalar bool
+    sum_lp: Array  # scalar f32: sum of committed tokens' log probs
+    tokens: Array  # [T+1] int32
+    attn: Array  # [T+1, T_enc] f32
+    pgens: Array  # [T+1] f32
+    f_state: Any  # full-model verify state
+    d_state: Any  # draft-model adapter state (K=1 leaves)
+    cycles: Array  # scalar int32
+    accepted: Array  # scalar int32
+    hist: Array  # [spec_k+1] int32
+
+
+def _greedy_choice(topk_ids: Array, topk_lps: Array, t: Array,
+                   min_dec_steps: int):
+    """The greedy policy shared by draft proposal and verify: argmax
+    with STOP discarded before ``min_dec_steps`` — exactly the
+    ``beam_size=1`` triage (an early STOP candidate is dropped and the
+    next-best continuation survives, beam_search.py:143-154), so greedy
+    == beam-1 token for token.  ``topk_*`` are ONE position's top-2
+    (descending); returns (token, its log prob)."""
+    blocked = jnp.logical_and(topk_ids == STOP_ID, t < min_dec_steps)
+    idx = jnp.argmax(jnp.where(blocked, NEG, topk_lps))
+    return topk_ids[idx], topk_lps[idx]
+
+
+def _map_unk(tokens: Array, vocab_size: int) -> Array:
+    """Extended-vocab ids feed back as UNK (beam_search.py:112)."""
+    return jnp.where(tokens >= vocab_size, UNK_ID, tokens)
+
+
+def _make_full_driver(params, hps: HParams, spec_k: int, enc_one,
+                      enc_mask, ext_ids):
+    """(init_state, verify, commit) for the FULL model.
+
+    verify(state, t0, inputs[S]) -> (choices [S], lps [S],
+    attn [S, T_enc], pgen [S], aux); commit(aux, a) -> the state
+    consistent with the prefix extended by the first a+1 inputs.
+    """
+    S = spec_k + 1
+    choose = jax.vmap(_greedy_choice, in_axes=(0, 0, 0, None))
+
+    if hps.model_family == "transformer":
+        family = get_family(hps.model_family)
+
+        def init_state():
+            return family.spec_init_state(hps, spec_k)
+
+        def verify(state, t0, inputs):
+            tids, tlps, attn, pgen, new_state = family.spec_verify(
+                params, hps, enc_one, enc_mask, ext_ids, t0,
+                _map_unk(inputs, hps.vocab_size), state)
+            toks, lps = choose(tids, tlps, t0 + jnp.arange(S),
+                               hps.min_dec_steps)
+            return toks, lps, attn, pgen, new_state
+
+        def commit(aux, a):
+            del a  # append-only cache: validity rides the step counter
+            return aux
+
+        return init_state, verify, commit
+
+    family = get_family(hps.model_family)
+    init_fn, step_fn = family.beam_adapter(hps)
+
+    def init_state():
+        return init_fn(params, enc_one)
+
+    def verify(state, t0, inputs):
+        def body(st, j_inp):
+            j, inp = j_inp
+            latest = _map_unk(inp, hps.vocab_size)[None]
+            out = step_fn(params, enc_one, enc_mask, ext_ids, t0 + j,
+                          latest, st)
+            return out.state, (out.topk_ids[0], out.topk_log_probs[0],
+                               out.attn_dist[0], out.p_gen[0], out.state)
+
+        _, (tids, tlps, attn, pgen, states) = jax.lax.scan(
+            body, state, (jnp.arange(S), inputs))
+        toks, lps = choose(tids, tlps, t0 + jnp.arange(S),
+                           hps.min_dec_steps)
+        return toks, lps, attn, pgen, states
+
+    def commit(aux, a):
+        # stacked[j] = state after consuming inputs 0..j; accepting a
+        # draft tokens means the prefix grew by inputs 0..a
+        return jax.tree_util.tree_map(lambda x: x[a], aux)
+
+    return init_state, verify, commit
+
+
+def _spec_body(draft_params, fhps: HParams, spec_k: int, d_enc_one,
+               enc_mask, ext_ids, verify, commit, d_step):
+    """One draft-propose / verify / accept / commit cycle for one
+    article — the loop body `_spec_one` runs under lax.while_loop.
+    The full model arrives entirely through the `verify`/`commit`
+    closures (already closed over params and encoder view); only the
+    draft's step still needs its raw operands here.  Factored out so
+    the tslint hot list can name it (TS002)."""
+    T = fhps.max_dec_steps
+    V = fhps.vocab_size
+    S = spec_k + 1
+
+    def body(c: _SpecCarry) -> _SpecCarry:
+        # --- draft proposes spec_k tokens greedily (S = spec_k+1 steps:
+        # the extra step computes the accept-all resync state) ---
+        def d_body(dc, j):
+            st, latest = dc
+            out = d_step(draft_params, d_enc_one, enc_mask, ext_ids,
+                         c.t + j, latest[None], st)
+            tok, _ = _greedy_choice(out.topk_ids[0], out.topk_log_probs[0],
+                                    c.t + j, fhps.min_dec_steps)
+            return (out.state, _map_unk(tok, V)), (tok, out.state)
+
+        (_, _), (d_toks, d_states) = jax.lax.scan(
+            d_body, (c.d_state, _map_unk(c.last, V)), jnp.arange(S))
+        # d_toks[j] = the draft's proposal for position t+j+1
+
+        # --- full model scores all S positions in one batched step ---
+        inputs = jnp.concatenate([c.last[None], d_toks[:spec_k]])
+        g_toks, g_lps, v_attn, v_pgen, v_aux = verify(c.f_state, c.t,
+                                                      inputs)
+
+        # --- longest agreeing prefix + correction (traced length) ---
+        agree = (d_toks[:spec_k] == g_toks[:spec_k]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(agree))  # 0..spec_k leading agreements
+        e = jnp.where(jnp.arange(S) < a, d_toks, g_toks)  # emitted run
+        within = jnp.arange(S) <= a
+        is_stop = jnp.logical_and(e == STOP_ID, within)
+        any_stop = jnp.any(is_stop)
+        first_stop = jnp.argmax(is_stop)
+        n_limit = jnp.where(any_stop, first_stop + 1, a + 1)
+        n = jnp.minimum(n_limit, T - c.t)  # >= 1: loop only runs t < T
+        valid = jnp.arange(S) < n
+
+        # --- commit: scatter the n emitted tokens (scratch row T
+        # absorbs the truncated tail) and advance both models ---
+        widx = jnp.where(valid, c.t + jnp.arange(S), T)
+        stopped = jnp.logical_and(any_stop, first_stop < n)
+        t2 = c.t + n
+        return _SpecCarry(
+            t=t2,
+            last=e[n - 1],
+            done=jnp.logical_or(stopped, t2 >= T),
+            sum_lp=c.sum_lp + jnp.sum(jnp.where(valid, g_lps, 0.0)),
+            tokens=c.tokens.at[widx].set(e),
+            attn=c.attn.at[widx].set(v_attn),
+            pgens=c.pgens.at[widx].set(v_pgen),
+            f_state=commit(v_aux, a),
+            d_state=jax.tree_util.tree_map(lambda x: x[n - 1], d_states),
+            cycles=c.cycles + 1,
+            accepted=c.accepted + a,
+            hist=c.hist.at[a].add(1),
+        )
+
+    return body
+
+
+def _spec_one(full_params, draft_params, fhps: HParams, dhps: HParams,
+              spec_k: int, f_enc_one, d_enc_one, enc_mask, ext_ids):
+    """Speculative decode for ONE article (vmapped over the batch).
+    fhps/dhps arrive with beam_size=1 — run_spec_decode, the one host
+    entry, normalizes them so the jit cache key cannot fragment over a
+    beam width the engine ignores."""
+    T = fhps.max_dec_steps
+    T_enc = enc_mask.shape[0]
+    f_init, verify, commit = _make_full_driver(
+        full_params, fhps, spec_k, f_enc_one, enc_mask, ext_ids)
+    d_init_fn, d_step = get_family(dhps.model_family).beam_adapter(dhps)
+    body = _spec_body(draft_params, fhps, spec_k, d_enc_one, enc_mask,
+                      ext_ids, verify, commit, d_step)
+    init = _SpecCarry(
+        t=jnp.zeros((), jnp.int32),
+        last=jnp.asarray(START_ID, jnp.int32),
+        done=jnp.zeros((), jnp.bool_),
+        sum_lp=jnp.zeros((), jnp.float32),
+        tokens=jnp.zeros((T + 1,), jnp.int32),
+        attn=jnp.zeros((T + 1, T_enc), jnp.float32),
+        pgens=jnp.zeros((T + 1,), jnp.float32),
+        f_state=f_init(),
+        d_state=d_init_fn(draft_params, d_enc_one),
+        cycles=jnp.zeros((), jnp.int32),
+        accepted=jnp.zeros((), jnp.int32),
+        hist=jnp.zeros((spec_k + 1,), jnp.int32),
+    )
+    c = jax.lax.while_loop(lambda s: jnp.logical_not(s.done), body, init)
+    length = c.t + 1  # generated tokens + START (the beam length rule)
+    return SpecDecodeOutput(
+        tokens=jnp.concatenate([jnp.array([START_ID], jnp.int32),
+                                c.tokens[:T]]),
+        length=length,
+        avg_log_prob=c.sum_lp / length.astype(jnp.float32),
+        attn_dists=c.attn[:T],
+        p_gens=c.pgens[:T],
+        cycles=c.cycles,
+        drafted=c.cycles * spec_k,
+        accepted=c.accepted,
+        accept_hist=c.hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("fhps", "dhps", "spec_k"))
+def run_spec_decode_jit(full_params, draft_params, fhps: HParams,
+                        dhps: HParams, arrays: Dict[str, Array],
+                        spec_k: int) -> SpecDecodeOutput:
+    """One compiled dispatch speculatively decodes the whole batch.
+    Both models encode the article batch once; the per-article loop is
+    vmapped.  Everything downstream of the encoders is shape-static —
+    accept length, cycle count, and slot content are all traced.
+    fhps/dhps must carry beam_size=1 (the engine is single-hypothesis;
+    ``run_spec_decode`` normalizes so differing beam widths cannot
+    fragment the jit cache)."""
+    f_family = get_family(fhps.model_family)
+    d_family = get_family(dhps.model_family)
+    f_enc = f_family.beam_encode(full_params, fhps, arrays)
+    d_enc = d_family.beam_encode(draft_params, dhps, arrays)
+    fn = functools.partial(_spec_one, full_params, draft_params, fhps,
+                           dhps, spec_k)
+    return jax.vmap(fn)(f_enc, d_enc, arrays["enc_padding_mask"],
+                        arrays["enc_batch_extend_vocab"])
+
+
+def run_spec_decode(full_params, draft_params, hps: HParams,
+                    arrays: Dict[str, np.ndarray]) -> SpecDecodeOutput:
+    """Host entry: resolve the draft shape (config.derive_draft_hps),
+    dispatch once, return host numpy (run_beam_search's contract, plus
+    the speculative stats)."""
+    fhps = hps.replace(beam_size=1)  # the verify path is single-hyp
+    dhps = derive_draft_hps(hps).replace(beam_size=1, mode="decode")
+    enc_arrays = {k: v for k, v in arrays.items() if k.startswith("enc_")}
+    try:  # mirror run_beam_search's compile-cache telemetry
+        before = run_spec_decode_jit._cache_size()
+    except Exception:  # tslint: disable=TS005 — private jax API; telemetry must never break decode
+        before = None
+    out = run_spec_decode_jit(full_params, draft_params, fhps, dhps,
+                              enc_arrays, int(hps.spec_k))
+    if before is not None:
+        try:
+            from textsummarization_on_flink_tpu import obs
+
+            missed = run_spec_decode_jit._cache_size() > before
+            obs.registry_for(hps).counter(
+                "decode/compile_cache_misses_total" if missed
+                else "decode/compile_cache_hits_total").inc()
+        except Exception:  # tslint: disable=TS005 — best-effort cache-hit telemetry; decode result already in hand
+            pass
+    return SpecDecodeOutput(*[np.asarray(x) for x in out])
+
+
+def expected_speedup(alpha: float, spec_k: int, draft_ratio: float) -> float:
+    """Expected spec-tier speedup over plain greedy under the
+    bandwidth-bound decode model (PERF.md "Speculative tier"): with
+    per-position acceptance probability ``alpha``, a cycle emits
+    E = (1 - alpha^(k+1)) / (1 - alpha) tokens in expectation and costs
+    (k+1) draft steps (the +1 is the resync step) plus ONE full-model
+    invocation — the parallel verify streams the full model's weights
+    once for all k+1 positions, which is what makes a verify invocation
+    ~one full step on a bandwidth-bound decoder.  ``draft_ratio`` is
+    the committed draft/full per-step cost ratio (BYTE_BUDGET.json
+    "spec").  Greedy costs 1 full step per token, so speedup =
+    E / ((k+1) * ratio + 1)."""
+    a = min(max(float(alpha), 0.0), 1.0)
+    if a >= 1.0:
+        e = float(spec_k + 1)
+    else:
+        e = (1.0 - a ** (spec_k + 1)) / (1.0 - a)
+    return e / ((spec_k + 1) * float(draft_ratio) + 1.0)

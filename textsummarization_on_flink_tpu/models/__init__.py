@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from types import ModuleType
 
-FAMILIES = ("pointer_generator", "transformer")
+FAMILIES = ("pointer_generator", "transformer", "avg_attention")
 
 
 def get_family(name: str) -> ModuleType:
@@ -30,5 +30,8 @@ def get_family(name: str) -> ModuleType:
     if name == "transformer":
         from textsummarization_on_flink_tpu.models import transformer
         return transformer
+    if name == "avg_attention":
+        from textsummarization_on_flink_tpu.models import avg_attention
+        return avg_attention
     raise ValueError(
         f"unknown model_family {name!r}; expected one of {FAMILIES}")

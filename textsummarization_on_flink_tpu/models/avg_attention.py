@@ -1,0 +1,351 @@
+"""Average-attention (AAN) draft decoder family — the speculative tier's
+cheap proposer (ISSUE 10; ROADMAP item 4).
+
+*Accelerating Neural Transformer via an Average Attention Network*
+(PAPERS.md): replace decoder self-attention with a CUMULATIVE-AVERAGE
+layer — position t summarizes its prefix as the running mean of the
+layer inputs, passed through a small FFN and merged with the current
+input through a learned forget/input gate.  The decode step then carries
+ONE running sum per layer instead of a growing KV cache, so per-token
+cost and resident state are O(1) in history — the property that makes
+this family the draft tier under continuous serving (a draft slot is
+``L*H`` floats, vs the transformer's ``2*L*T*nh*hd`` cache).
+
+Everything around the decoder self-attention is the transformer family
+verbatim — the SAME encoder stack (``transformer._encoder_stack``), the
+same per-layer cross-attention/copy mechanism, the same tied-embedding
+loss head (``transformer.train_output_tail``), the same
+``TransformerEncView`` encoder view — so the family plugs into beam
+search, serving, checkpointing, and the sharding registry with zero new
+plumbing (param leaf names match the transformer's where shared).
+
+Two init modes:
+
+  * ``init_params`` — fresh (training a draft from scratch / tests);
+  * ``init_from_transformer`` — the distilled greedy-draft bootstrap: a
+    tf1_import-style declarative mapping copies every shared leaf from a
+    full-model checkpoint (embedding, positions, the WHOLE encoder, an
+    evenly-strided subset of decoder layers' cross-attention/LN/FFN, the
+    loss head) and fresh-initializes only the AAN average-FFN and gate,
+    which have no full-model counterpart.  The mapped draft starts out
+    proposing from the full model's own representations — acceptance is
+    non-trivial from step zero, no distillation run required.
+
+Numerics note: ``forward_train`` computes the prefix mean with
+``jnp.cumsum`` (one parallel pass over T_dec) while the decode step adds
+to a running f32 sum — different summation trees, so train/decode parity
+is tight-tolerance, not bitwise (pinned by test).  Beam-loop parity
+(while/scan/chunked/slot) IS exact: every loop kind drives the same
+jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.models import pointer_generator as pg
+from textsummarization_on_flink_tpu.models import transformer as tf
+
+Array = jax.Array
+Params = Dict[str, Any]
+
+TrainOutput = pg.TrainOutput
+BeamStepOut = pg.BeamStepOut
+TransformerEncView = tf.TransformerEncView
+
+
+# --------------------------------------------------------------------------
+# Init
+# --------------------------------------------------------------------------
+
+def _init_aan_layer(key: Array, H: int, F: int) -> Dict[str, Any]:
+    k_ffn, k_gate = jax.random.split(key)
+    return {
+        "ln1": tf._init_ln(H),
+        # the average branch: FFN over the prefix mean, then a 2H->2H
+        # input/forget gate over [x_t, ffn(avg_t)] (AAN §3.2)
+        "aan_ffn": tf._init_ffn(k_ffn, H, F),
+        "aan_gate": {"kernel": pg._glorot(k_gate, (2 * H, 2 * H)),
+                     "bias": jnp.zeros((2 * H,), jnp.float32)},
+        "ln_cross": tf._init_ln(H),
+        "cross_attn": None,  # filled by caller (needs its own key)
+        "ln2": tf._init_ln(H),
+        "ffn": None,  # filled by caller
+    }
+
+
+def init_params(hps: HParams, vsize: int, key: Array) -> Params:
+    """Fresh parameter pytree.  Shared leaves carry the transformer
+    family's names/layout (embedding, pos_enc/pos_dec, encoder,
+    decoder.layers[i].{ln1,ln_cross,cross_attn,ln2,ffn}, pgen_linear,
+    out_bias) so sharding rules and the checkpoint mapping apply
+    unchanged; only aan_ffn/aan_gate are family-specific."""
+    H, F = hps.hidden_dim, hps.ffn_width
+    n_keys = 3 + 2 * hps.enc_layers + 4 * hps.dec_layers + 1
+    keys = iter(jax.random.split(key, n_keys))
+
+    enc_layers = []
+    for _ in range(hps.enc_layers):
+        enc_layers.append({
+            "ln1": tf._init_ln(H), "self_attn": tf._init_attn(next(keys), H),
+            "ln2": tf._init_ln(H), "ffn": tf._init_ffn(next(keys), H, F),
+        })
+    dec_layers = []
+    for _ in range(hps.dec_layers):
+        layer = _init_aan_layer(next(keys), H, F)
+        layer["cross_attn"] = tf._init_attn(next(keys), H)
+        layer["ffn"] = tf._init_ffn(next(keys), H, F)
+        dec_layers.append(layer)
+    return {
+        "embedding": pg._trunc_normal(next(keys), (vsize, H), 0.02),
+        "pos_enc": pg._trunc_normal(next(keys), (hps.max_enc_steps, H), 0.02),
+        "pos_dec": pg._trunc_normal(next(keys), (hps.max_dec_steps + 1, H),
+                                    0.02),
+        "encoder": {"layers": enc_layers, "ln_out": tf._init_ln(H)},
+        "decoder": {"layers": dec_layers, "ln_out": tf._init_ln(H)},
+        "pgen_linear": {"kernel": pg._glorot(next(keys), (2 * H, 1)),
+                        "bias": jnp.zeros((1,), jnp.float32)},
+        "out_bias": jnp.zeros((vsize,), jnp.float32),
+    }
+
+
+#: decoder-layer leaves copied 1:1 from the mapped full-model layer
+#: (tf1_import-style declarative map — the strict check below guarantees
+#: every draft leaf is either on this list or in _FRESH_KEYS)
+_MAPPED_LAYER_KEYS = ("ln1", "ln_cross", "cross_attn", "ln2", "ffn")
+#: family-specific leaves with no full-model counterpart — fresh init
+_FRESH_KEYS = ("aan_ffn", "aan_gate")
+
+
+def draft_layer_indices(full_layers: int, draft_layers: int) -> List[int]:
+    """Evenly-strided subset of the full model's decoder layers the
+    mapped draft keeps (first and last always included when
+    draft_layers >= 2): the standard layer-skip draft recipe."""
+    if draft_layers >= full_layers:
+        return list(range(full_layers))
+    if draft_layers == 1:
+        return [full_layers - 1]  # the layer feeding the loss head
+    step = (full_layers - 1) / (draft_layers - 1)
+    return sorted({round(i * step) for i in range(draft_layers)})
+
+
+def init_from_transformer(full_params: Params, full_hps: HParams,
+                          draft_hps: HParams, key: Array) -> Params:
+    """The distilled greedy-draft bootstrap: build AAN draft params from
+    a FULL transformer checkpoint (checkpoint/tf1_import.py-style
+    declarative mapping — copy shared leaves, fresh-init the rest,
+    strict-check that nothing falls through).
+
+    Copied: embedding/pos_enc/pos_dec, the whole encoder, out_bias,
+    pgen_linear, decoder ln_out, and — for each of the
+    ``draft_hps.dec_layers`` evenly-strided kept layers —
+    ln1/ln_cross/cross_attn/ln2/ffn.  Fresh: aan_ffn + aan_gate (no
+    counterpart; the cumulative-average branch replaces self-attention).
+    """
+    if full_hps.model_family != "transformer":
+        raise ValueError(
+            f"init_from_transformer maps transformer checkpoints only, "
+            f"got model_family={full_hps.model_family!r} (use fresh init "
+            f"or a separately trained draft for other families)")
+    if draft_hps.hidden_dim != full_hps.hidden_dim:
+        raise ValueError(
+            f"mapped draft must share hidden_dim with the full model "
+            f"(draft {draft_hps.hidden_dim} vs full {full_hps.hidden_dim})")
+    H, F = draft_hps.hidden_dim, draft_hps.ffn_width
+    cp = lambda x: jnp.asarray(x)  # noqa: E731 — copy-by-reference is fine
+    keep = draft_layer_indices(full_hps.dec_layers, draft_hps.dec_layers)
+    keys = iter(jax.random.split(key, len(keep)))
+    dec_layers = []
+    for src_idx in keep:
+        src = full_params["decoder"]["layers"][src_idx]
+        layer = _init_aan_layer(next(keys), H, F)
+        for k in _MAPPED_LAYER_KEYS:
+            layer[k] = jax.tree_util.tree_map(cp, src[k])
+        dec_layers.append(layer)
+        # strict check (tf1_import discipline): every key accounted for
+        unknown = set(layer) - set(_MAPPED_LAYER_KEYS) - set(_FRESH_KEYS)
+        if unknown:
+            raise KeyError(f"unmapped draft layer keys: {sorted(unknown)}")
+    return {
+        "embedding": cp(full_params["embedding"]),
+        "pos_enc": cp(full_params["pos_enc"]),
+        "pos_dec": cp(full_params["pos_dec"]),
+        "encoder": jax.tree_util.tree_map(cp, full_params["encoder"]),
+        "decoder": {"layers": dec_layers,
+                    "ln_out": jax.tree_util.tree_map(
+                        cp, full_params["decoder"]["ln_out"])},
+        "pgen_linear": jax.tree_util.tree_map(cp,
+                                              full_params["pgen_linear"]),
+        "out_bias": cp(full_params["out_bias"]),
+    }
+
+
+def make_draft_params(hps: HParams, full_params: Params,
+                      seed: int = 0) -> Params:
+    """Resolve ``hps.spec_draft`` to draft parameters: 'map' = the
+    transformer->AAN checkpoint mapping above, 'fresh' = random init
+    (tests/smokes; near-zero acceptance but exactness still holds).
+    The ONE resolver — decode/decoder.py and scripts build drafts only
+    through here."""
+    from textsummarization_on_flink_tpu.config import derive_draft_hps
+
+    dhps = derive_draft_hps(hps)
+    if hps.spec_draft == "map":
+        return init_from_transformer(full_params, hps, dhps,
+                                     jax.random.PRNGKey(seed))
+    if hps.spec_draft == "fresh":
+        return init_params(dhps, hps.vocab_size, jax.random.PRNGKey(seed))
+    raise ValueError(
+        f"make_draft_params needs spec_draft='map'|'fresh', got "
+        f"{hps.spec_draft!r}")
+
+
+# --------------------------------------------------------------------------
+# The cumulative-average block
+# --------------------------------------------------------------------------
+
+def _aan_gate(layer: Dict[str, Any], x_norm: Array, g: Array) -> Array:
+    """Input/forget gating of the current input against the averaged
+    branch (AAN §3.2): ``i, f = sigmoid(W [x; g])``, out = i*x + f*g."""
+    dt = x_norm.dtype
+    H = x_norm.shape[-1]
+    gates = jax.nn.sigmoid(
+        jnp.concatenate([x_norm, g], axis=-1)
+        @ layer["aan_gate"]["kernel"].astype(dt)
+        + layer["aan_gate"]["bias"].astype(dt))
+    return gates[..., :H] * x_norm + gates[..., H:] * g
+
+
+def _aan_block_train(layer: Dict[str, Any], x_norm: Array) -> Array:
+    """Teacher-forced cumulative-average branch over the time axis
+    (axis -2): prefix mean via one parallel cumsum (f32 accumulate),
+    FFN, gate.  The decode step computes the same quantity from a
+    running sum — see the module docstring's numerics note."""
+    T = x_norm.shape[-2]
+    csum = jnp.cumsum(x_norm.astype(jnp.float32), axis=-2)
+    denom = (jnp.arange(T, dtype=jnp.float32) + 1.0)[:, None]
+    avg = (csum / denom).astype(x_norm.dtype)
+    g = tf._ffn_block(layer["aan_ffn"], avg)
+    return _aan_gate(layer, x_norm, g)
+
+
+# --------------------------------------------------------------------------
+# Training forward (fully parallel over decode steps, like the transformer)
+# --------------------------------------------------------------------------
+
+def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
+                  ) -> TrainOutput:
+    """Teacher-forced forward -> TrainOutput through the SHARED loss head
+    (transformer.train_output_tail): same pointer mixture, same
+    --loss_chunk streaming, same coverage penalty."""
+    enc_mask = arrays["enc_padding_mask"]
+    T_dec = arrays["dec_batch"].shape[1]
+
+    x = tf._embed_enc(params, hps, arrays["enc_batch"])
+    enc_out = tf._encoder_stack(params, hps, x, enc_mask)
+    enc_out_c = pg._cast(hps, enc_out)
+
+    y = tf._embed_dec(params, hps, arrays["dec_batch"], jnp.arange(T_dec))
+    cross_mask = enc_mask[:, None, :]
+
+    def layer_fn(layer, y, enc_out_c, cross_mask):
+        a = _aan_block_train(layer, tf._ln(layer["ln1"], y))
+        y = y + a
+        c, probs = tf._mha(hps, layer["cross_attn"],
+                           tf._ln(layer["ln_cross"], y), enc_out_c,
+                           cross_mask)
+        y = y + c
+        y = y + tf._ffn_block(layer["ffn"], tf._ln(layer["ln2"], y))
+        return y, c, probs
+
+    if hps.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    attn_dist = None
+    for layer in params["decoder"]["layers"]:
+        y, c, probs = layer_fn(layer, y, enc_out_c, cross_mask)
+        attn_dist = probs
+        cross_ctx = c
+    h = tf._ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
+    return tf.train_output_tail(params, hps, arrays, h, cross_ctx, attn_dist)
+
+
+# --------------------------------------------------------------------------
+# Decoding (O(1)-in-history step + beam adapter)
+# --------------------------------------------------------------------------
+
+def beam_encode(params: Params, hps: HParams, arrays: Dict[str, Array],
+                ) -> TransformerEncView:
+    """Identical encoder view to the transformer family (per-layer
+    cross-attention K/V precomputed once per article) — the decoder
+    difference is entirely inside the step."""
+    return tf.beam_encode(params, hps, arrays)
+
+
+def decode_onestep(params: Params, hps: HParams,
+                   enc_one: TransformerEncView, enc_mask: Array,
+                   ext_ids: Array, t: Array, latest: Array,
+                   aan_sum: Array) -> Tuple[Array, Array, Array, Array,
+                                            Array]:
+    """One AAN decode step for K hypotheses: O(1) in history — the only
+    carried decode state is the [K, L, H] running sum (f32), updated by
+    one add; no cache gather, no attention over past positions.
+
+    Returns (final_dist [K, V_ext], attn_dist [K, T_enc], p_gen [K],
+    h [K, H], new_sum [K, L, H]).
+    """
+    y = tf._embed_dec(params, hps, latest, t)  # [K, H]
+    dt = y.dtype
+    new_sums = []
+    attn_dist = None
+    for li, layer in enumerate(params["decoder"]["layers"]):
+        x_norm = tf._ln(layer["ln1"], y)
+        s = aan_sum[:, li] + x_norm.astype(jnp.float32)  # running sum
+        new_sums.append(s)
+        avg = (s / (t.astype(jnp.float32) + 1.0)).astype(dt)
+        g = tf._ffn_block(layer["aan_ffn"], avg)
+        y = y + _aan_gate(layer, x_norm, g)
+        # cross attention + output head are the transformer family's
+        # shared decode blocks — one numerics source for all three
+        # decode paths (beam step / spec verify / this)
+        cross_out, attn_dist = tf.cross_attend_layer(
+            hps, layer, y, enc_one.cross_k[li], enc_one.cross_v[li],
+            enc_mask)
+        y = y + cross_out
+        y = y + tf._ffn_block(layer["ffn"], tf._ln(layer["ln2"], y))
+        cross_ctx = cross_out
+    final_dist, p_gen, h = tf.decode_output_tail(params, hps, y,
+                                                 cross_ctx, attn_dist,
+                                                 ext_ids)
+    new_sum = jnp.stack(new_sums, axis=1)  # [K, L, H]
+    return final_dist, attn_dist, p_gen, h, new_sum
+
+
+def beam_adapter(hps: HParams):
+    """Beam protocol (init_state, step): the decode state is ONE
+    [K, L, H] running-sum tensor — every loop kind (while/scan/chunked/
+    slot) works unmodified, and a resident draft slot costs L*H floats
+    instead of a KV cache."""
+    K = hps.beam_size
+    L = hps.dec_layers
+    H = hps.hidden_dim
+
+    def init_state(params: Params, enc_one: TransformerEncView):
+        del params, enc_one
+        return {"aan_sum": jnp.zeros((K, L, H), jnp.float32)}
+
+    def step(params: Params, enc_one: TransformerEncView, enc_mask: Array,
+             ext_ids: Array, t: Array, latest: Array, state) -> BeamStepOut:
+        final_dist, attn_dist, p_gen, _, new_sum = decode_onestep(
+            params, hps, enc_one, enc_mask, ext_ids, t, latest,
+            state["aan_sum"])
+        topk_probs, topk_ids = jax.lax.top_k(final_dist, 2 * hps.beam_size)
+        return BeamStepOut(topk_ids=topk_ids,
+                           topk_log_probs=jnp.log(topk_probs + 1e-10),
+                           attn_dist=attn_dist, p_gen=p_gen,
+                           state={"aan_sum": new_sum})
+
+    return init_state, step

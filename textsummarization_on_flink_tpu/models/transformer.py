@@ -346,7 +346,6 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
     as ops/losses.gold_mixture_prob, inlined in log space so the
     [B, T, V] softmax is never materialized)."""
     enc_mask = arrays["enc_padding_mask"]  # [B, T_enc]
-    dec_mask = arrays["dec_padding_mask"]  # [B, T_dec]
     T_dec = arrays["dec_batch"].shape[1]
 
     x = _embed_enc(params, hps, arrays["enc_batch"])
@@ -374,6 +373,23 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
         attn_dist = probs  # final layer's head-averaged copy distribution
         cross_ctx = c
     h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
+    return train_output_tail(params, hps, arrays, h, cross_ctx, attn_dist)
+
+
+def train_output_tail(params: Params, hps: HParams, arrays: Dict[str, Array],
+                      h: Array, cross_ctx: Array, attn_dist: Array,
+                      ) -> TrainOutput:
+    """The loss head shared by every transformer-shaped decoder family
+    (transformer, avg_attention): p_gen from [h, cross_ctx], tied vocab
+    projection (streamed when --loss_chunk, materialized otherwise),
+    pointer mixture or baseline CE, coverage penalty.  ONE source for the
+    mixture math keeps the families' losses from drifting.
+
+    h: [B, T_dec, H] final-LN decoder states (f32); cross_ctx: final
+    layer's cross-attention output; attn_dist: its head-averaged copy
+    distribution [B, T_dec, T_enc].
+    """
+    dec_mask = arrays["dec_padding_mask"]  # [B, T_dec]
 
     p_gens = jax.nn.sigmoid(
         jnp.concatenate([h, cross_ctx.astype(jnp.float32)], axis=-1)
@@ -450,6 +466,58 @@ def beam_encode(params: Params, hps: HParams, arrays: Dict[str, Array],
 BeamStepOut = pg.BeamStepOut  # shared beam protocol output type
 
 
+def cross_attend_layer(hps: HParams, layer: Dict[str, Any], y: Array,
+                       ck: Array, cv: Array, enc_mask: Array,
+                       ) -> Tuple[Array, Array]:
+    """One decoder layer's cross-attention against its precomputed
+    per-article K/V (``TransformerEncView`` slices) for a stack of R
+    query rows — beam hypotheses, verify positions, or the AAN draft's
+    rows all share this ONE block (the decode-side analogue of
+    ``train_output_tail``'s factoring: a numerics fix lands once).
+
+    y: [R, H]; ck/cv: [T_enc, nh, hd]; enc_mask: [T_enc].  Returns
+    (cross_out [R, H] — NOT yet residual-added — and the head-averaged
+    probabilities [R, T_enc], f32)."""
+    hd = _head_dim(hps)
+    dt = y.dtype
+    cp = layer["cross_attn"]
+    qc = _split_heads(hps, _ln(layer["ln_cross"], y) @ cp["wq"].astype(dt))
+    clogits = jnp.einsum("knd,tnd->knt", qc.astype(jnp.float32),
+                         ck.astype(jnp.float32)) * (hd ** -0.5)
+    clogits = jnp.where(enc_mask[None, None, :] > 0, clogits, -1e30)
+    cprobs = jax.nn.softmax(clogits, axis=-1)
+    any_key = jnp.sum(enc_mask) > 0
+    cprobs = jnp.where(any_key, cprobs, 0.0)
+    cctx = jnp.einsum("knt,tnd->knd", cprobs, cv.astype(jnp.float32))
+    cross_out = _merge_heads(cctx).astype(dt) @ cp["wo"].astype(dt)
+    return cross_out, jnp.mean(cprobs, axis=1)
+
+
+def decode_output_tail(params: Params, hps: HParams, y: Array,
+                       cross_ctx: Array, attn_dist: Array, ext_ids: Array,
+                       ) -> Tuple[Array, Array, Array]:
+    """Decoder output head shared by every transformer-shaped decode
+    path (beam adapter step, ``spec_verify``, the AAN step): final LN,
+    tied vocab projection, p_gen, pointer mixture.  Returns
+    (final_dist [R, V_ext], p_gen [R], h [R, H] f32)."""
+    h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
+    vocab_scores = pg._proj(hps, h, params["embedding"].T) \
+        + params["out_bias"]
+    vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
+    p_gen = jax.nn.sigmoid(
+        jnp.concatenate([h, cross_ctx.astype(jnp.float32)], axis=-1)
+        @ params["pgen_linear"]["kernel"]
+        + params["pgen_linear"]["bias"])[:, 0]
+    if hps.pointer_gen:
+        R = y.shape[0]
+        ext_r = jnp.broadcast_to(ext_ids[None], (R,) + ext_ids.shape)
+        final_dist = pg.final_distribution(hps, vocab_dist, attn_dist,
+                                           p_gen, ext_r)
+    else:
+        final_dist = vocab_dist
+    return final_dist, p_gen, h
+
+
 def beam_adapter(hps: HParams):
     """Beam-search protocol: (init_state, step) closures over params.
 
@@ -504,37 +572,15 @@ def beam_adapter(hps: HParams):
             ctx = jnp.einsum("knt,ktnd->knd", probs, vv)
             y = y + _merge_heads(ctx).astype(dt) @ p["wo"].astype(dt)
             # cross attention against the precomputed per-layer K/V
-            cp = layer["cross_attn"]
-            qc = _split_heads(hps,
-                              _ln(layer["ln_cross"], y) @ cp["wq"].astype(dt))
-            ck = enc_one.cross_k[li]  # [T_enc, nh, hd]
-            cv = enc_one.cross_v[li]
-            clogits = jnp.einsum("knd,tnd->knt", qc.astype(jnp.float32),
-                                 ck.astype(jnp.float32)) * (hd ** -0.5)
-            clogits = jnp.where(enc_mask[None, None, :] > 0, clogits, -1e30)
-            cprobs = jax.nn.softmax(clogits, axis=-1)
-            any_key = jnp.sum(enc_mask) > 0
-            cprobs = jnp.where(any_key, cprobs, 0.0)
-            cctx = jnp.einsum("knt,tnd->knd", cprobs, cv.astype(jnp.float32))
-            cross_out = _merge_heads(cctx).astype(dt) @ cp["wo"].astype(dt)
+            cross_out, attn_dist = cross_attend_layer(
+                hps, layer, y, enc_one.cross_k[li], enc_one.cross_v[li],
+                enc_mask)
             y = y + cross_out
             y = y + _ffn_block(layer["ffn"], _ln(layer["ln2"], y))
-            attn_dist = jnp.mean(cprobs, axis=1)  # [K, T_enc] head-avg
             cross_ctx = cross_out
-        h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
-        vocab_scores = pg._proj(hps, h, params["embedding"].T) \
-            + params["out_bias"]
-        vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
-        p_gen = jax.nn.sigmoid(
-            jnp.concatenate([h, cross_ctx.astype(jnp.float32)], axis=-1)
-            @ params["pgen_linear"]["kernel"]
-            + params["pgen_linear"]["bias"])[:, 0]
-        if hps.pointer_gen:
-            ext_k = jnp.broadcast_to(ext_ids[None], (K,) + ext_ids.shape)
-            final_dist = pg.final_distribution(hps, vocab_dist, attn_dist,
-                                               p_gen, ext_k)
-        else:
-            final_dist = vocab_dist
+        final_dist, p_gen, _ = decode_output_tail(params, hps, y,
+                                                  cross_ctx, attn_dist,
+                                                  ext_ids)
         topk_probs, topk_ids = jax.lax.top_k(final_dist, 2 * hps.beam_size)
         return BeamStepOut(topk_ids=topk_ids,
                            topk_log_probs=jnp.log(topk_probs + 1e-10),
@@ -542,3 +588,85 @@ def beam_adapter(hps: HParams):
                            state={"cache_k": cache_k, "cache_v": cache_v})
 
     return init_state, step
+
+
+# --------------------------------------------------------------------------
+# Speculative verify (parallel multi-position teacher-forced scoring)
+# --------------------------------------------------------------------------
+
+def spec_init_state(hps: HParams, spec_k: int) -> Dict[str, Array]:
+    """Single-hypothesis KV cache for the speculative verifier
+    (decode/speculative.py): [L, W, nh, hd] with W = max_dec_steps +
+    spec_k + 1, wide enough that a verify block starting at the last
+    in-horizon step (t = T-1) writes its k+1 entries without clamping.
+    Position validity comes from the committed step counter, exactly
+    like the incremental adapter's cache — rejected draft positions are
+    simply never attended and the next block overwrites them."""
+    L = hps.dec_layers
+    nh, hd = hps.num_heads, _head_dim(hps)
+    W = hps.max_dec_steps + spec_k + 1
+    cache_dtype = (jnp.bfloat16 if hps.decode_cache_dtype == "bfloat16"
+                   else jnp.float32)
+    return {
+        "cache_k": jnp.zeros((L, W, nh, hd), cache_dtype),
+        "cache_v": jnp.zeros((L, W, nh, hd), cache_dtype),
+    }
+
+
+def spec_verify(params: Params, hps: HParams, enc_one: TransformerEncView,
+                enc_mask: Array, ext_ids: Array, t0: Array, tokens: Array,
+                state: Dict[str, Array]):
+    """Score S = spec_k + 1 teacher-forced positions in ONE parallel
+    decoder pass — the speculative fast path's "one fat step" for the
+    full model (decode/speculative.py; ISSUE 10).
+
+    ``tokens`` [S] are the inputs consumed at steps t0 .. t0+S-1 (the
+    last committed token followed by the draft's proposals, already
+    OOV→UNK mapped by the caller).  Each position's Q attends the cache
+    entries at positions <= its own step — the SAME masked-softmax the
+    incremental ``beam_adapter`` step computes, just batched over the S
+    query rows (extra masked columns contribute exact zeros, so the
+    per-position numerics match the K=1 incremental step; the spec
+    exactness tests pin this).  Returns per-position
+    ``(topk_ids [S, 2], topk_log_probs [S, 2], attn_dist [S, T_enc],
+    p_gen [S], state')`` where state' holds all S cache entries —
+    append-only: acceptance never rolls the cache back, the committed
+    step counter does.
+    """
+    S = tokens.shape[0]
+    hd = _head_dim(hps)
+    W = state["cache_k"].shape[1]
+    cache_dtype = state["cache_k"].dtype
+    pos = t0 + jnp.arange(S)  # [S] absolute decode steps
+    y = _embed_dec(params, hps, tokens, pos)  # [S, H]
+    dt = y.dtype
+    cache_k, cache_v = state["cache_k"], state["cache_v"]
+    pos_ok = jnp.arange(W)[None, :] <= pos[:, None]  # [S, W]
+    attn_dist = None
+    for li, layer in enumerate(params["decoder"]["layers"]):
+        p = layer["self_attn"]
+        h_norm = _ln(layer["ln1"], y)
+        q = _split_heads(hps, h_norm @ p["wq"].astype(dt))  # [S, nh, hd]
+        k_new = _split_heads(hps, h_norm @ p["wk"].astype(dt))
+        v_new = _split_heads(hps, h_norm @ p["wv"].astype(dt))
+        cache_k = cache_k.at[li, pos].set(k_new.astype(cache_dtype))
+        cache_v = cache_v.at[li, pos].set(v_new.astype(cache_dtype))
+        kk = cache_k[li].astype(jnp.float32)  # [W, nh, hd]
+        vv = cache_v[li].astype(jnp.float32)
+        logits = jnp.einsum("snd,tnd->snt", q.astype(jnp.float32), kk)
+        logits = logits * (hd ** -0.5)
+        logits = jnp.where(pos_ok[:, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("snt,tnd->snd", probs, vv)
+        y = y + _merge_heads(ctx).astype(dt) @ p["wo"].astype(dt)
+        cross_out, attn_dist = cross_attend_layer(
+            hps, layer, y, enc_one.cross_k[li], enc_one.cross_v[li],
+            enc_mask)
+        y = y + cross_out
+        y = y + _ffn_block(layer["ffn"], _ln(layer["ln2"], y))
+        cross_ctx = cross_out
+    final_dist, p_gen, _ = decode_output_tail(params, hps, y, cross_ctx,
+                                              attn_dist, ext_ids)
+    topk_probs, topk_ids = jax.lax.top_k(final_dist, 2)
+    return (topk_ids, jnp.log(topk_probs + 1e-10), attn_dist, p_gen,
+            {"cache_k": cache_k, "cache_v": cache_v})

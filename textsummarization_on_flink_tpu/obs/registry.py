@@ -141,13 +141,19 @@ class Histogram:
                 lo = mid + 1
         return lo
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, n: int = 1) -> None:
+        """Record `v`, optionally `n` times in one lock acquisition —
+        for call sites that already hold aggregated per-value counts
+        (e.g. the spec tier's device-side accept-length histogram);
+        identical to n separate observes."""
         v = float(v)
+        if n < 1:
+            return
         i = self._bucket_index(v)
         with self._lock:
-            self._counts[i] += 1
-            self._sum += v
-            self._count += 1
+            self._counts[i] += n
+            self._sum += v * n
+            self._count += n
             if v < self._min:
                 self._min = v
             if v > self._max:
@@ -257,7 +263,7 @@ class _NullHistogram:
     mean = 0.0
     buckets = ()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, n: int = 1) -> None:
         pass
 
     def percentile(self, q: float) -> float:

@@ -21,8 +21,10 @@ Layer map:
     decode with in-flight refill at chunk boundaries — no
     dispatch-window barrier; jax-free, engine injected).
   * ``server``  — ``ServingServer``: submit()/serve() fronting the
-    decoder, deadline-from-enqueue degradation, between-batch
-    checkpoint hot-swap, full obs instrumentation.
+    decoder, per-request quality tiers (``submit(tier=...)`` —
+    beam/greedy/spec/draft, SERVING.md "Quality tiers") with
+    per-request deadline re-tiering, between-batch checkpoint
+    hot-swap, full obs instrumentation.
 
 ``serve.queue``/``serve.batcher`` never import jax; ``serve.server``
 defers the decoder import until it actually builds one, so admission

@@ -138,15 +138,21 @@ class ServeRequest:
     """One admitted (or about-to-be-admitted) summarization request."""
 
     __slots__ = ("uuid", "article", "reference", "example", "future",
-                 "deadline", "enqueue_t", "trace")
+                 "deadline", "enqueue_t", "trace", "tier")
 
     def __init__(self, uuid: str, article: str, reference: str,
                  example: Any, deadline: Optional[Deadline] = None,
-                 registry: Optional[obs.Registry] = None):
+                 registry: Optional[obs.Registry] = None,
+                 tier: str = ""):
         self.uuid = uuid
         self.article = article
         self.reference = reference
         self.example = example  # data.batching.SummaryExample
+        # requested quality tier (SERVING.md "Quality tiers"): one of
+        # config.SERVE_TIERS, or "" = the server's default.  The
+        # EFFECTIVE tier may be lower — per-request deadline-pressure
+        # degradation happens at dispatch, not here.
+        self.tier = tier
         self.future = ServeFuture(uuid, registry=registry)
         # request-scoped trace root (ISSUE 9): minted at the request's
         # birth on the SUBMIT thread and carried on the object, so the

@@ -35,12 +35,16 @@ Contracts (both modes):
     budget died waiting in the queue is evicted with the typed
     ``DeadlineExceededError`` (counted in
     ``serve/deadline_evictions_total``) instead of burning a dispatch.
-    Beyond that the modes differ: a micro-batch dispatches under the
-    TIGHTEST deadline of its members, reusing the decoder's
-    beam->greedy degradation ladder (``_should_degrade``, degraded
-    results tagged and counted); continuous mode never degrades (the
-    slot state is fixed-beam) — an expired RESIDENT is evicted typed at
-    the next chunk boundary;
+    Beyond that the modes differ: micro-batch requests carry a quality
+    TIER (``submit(tier=...)`` — beam|greedy|spec|draft, SERVING.md
+    "Quality tiers") and each group member whose budget cannot cover
+    the full-beam estimate is re-tiered ALONE
+    (beam->``serve_degrade_tier``, spec->draft; counted per request in
+    ``serve/degraded_total`` and per requested tier) — the group then
+    dispatches once per effective tier under each sub-group's tightest
+    deadline; continuous mode never degrades (the slot state is
+    fixed-beam, non-beam tiers are rejected at submit) — an expired
+    RESIDENT is evicted typed at the next chunk boundary;
   * checkpoint hot-swap happens BETWEEN dispatches via the decoder's
     lock-guarded ``maybe_reload_checkpoint`` — between batches
     (microbatch) or ticks (continuous, where new params land at the
@@ -52,8 +56,9 @@ Contracts (both modes):
 
 Observability (SERVING.md): serve/queue_depth, serve/time_in_queue_
 seconds, serve/batch_fill, serve/e2e_latency_seconds, serve/shed_total,
-serve/degraded_total, serve/errors_total.  Chaos: injection point
-``serve.dispatch`` fails whole batches deterministically.
+serve/degraded_total, serve/errors_total, and the per-tier family
+(serve/tier_*_total).  Chaos: injection point ``serve.dispatch`` fails
+whole (sub-)batches deterministically.
 """
 
 from __future__ import annotations
@@ -68,6 +73,7 @@ from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.obs import flightrec
 from textsummarization_on_flink_tpu.obs import http as obs_http
 from textsummarization_on_flink_tpu.config import (
+    SERVE_TIERS,
     HParams,
     resolve_refill_chunk,
     resolve_serve_slots,
@@ -183,6 +189,20 @@ class ServingServer:
         self._c_rows_out = self._reg.counter("serve/sink_rows_total")
         self._c_evictions = self._reg.counter(
             "serve/deadline_evictions_total")
+        # per-tier telemetry (SERVING.md "Quality tiers"): completions
+        # by EFFECTIVE tier, and degradations by the tier the request
+        # ASKED for — literal metric names (the obs doc-drift gate scans
+        # for literals), looked up through these dicts
+        self._c_tier_done = {
+            "beam": self._reg.counter("serve/tier_beam_total"),
+            "greedy": self._reg.counter("serve/tier_greedy_total"),
+            "spec": self._reg.counter("serve/tier_spec_total"),
+            "draft": self._reg.counter("serve/tier_draft_total"),
+        }
+        self._c_tier_degraded = {
+            "beam": self._reg.counter("serve/tier_degraded_beam_total"),
+            "spec": self._reg.counter("serve/tier_degraded_spec_total"),
+        }
 
     # -- lifecycle --
     def start(self) -> "ServingServer":
@@ -224,8 +244,8 @@ class ServingServer:
 
     # -- request API --
     def submit(self, article: str, uuid: str = "", reference: str = "",
-               block: bool = False,
-               timeout: Optional[float] = None) -> ServeFuture:
+               block: bool = False, timeout: Optional[float] = None,
+               tier: str = "") -> ServeFuture:
         """Admit one request; returns its future.
 
         Non-blocking (default): full queue / open admission breaker
@@ -233,9 +253,37 @@ class ServingServer:
         retries with backoff.  ``block=True`` waits up to `timeout` for
         queue space instead (pipeline backpressure mode).
 
+        ``tier`` picks the request's quality tier
+        (beam|greedy|spec|draft, SERVING.md "Quality tiers"; "" = the
+        job's ``serve_default_tier``).  Tier problems are caller errors
+        and fail HERE, synchronously: an unknown tier, a spec/draft ask
+        against a decoder with no draft model, or a non-beam tier on a
+        continuous-mode server (the persistent slot state is fixed-beam
+        by construction).
+
         The per-request Deadline starts NOW (enqueue), so queue wait
         spends the ``decode_deadline_secs`` budget and an aged request
         degrades to greedy exactly like a slow one (RESILIENCE.md)."""
+        tier = tier or getattr(self._hps, "serve_default_tier", "beam")
+        if tier not in SERVE_TIERS:
+            raise ValueError(
+                f"tier must be one of {SERVE_TIERS}, got {tier!r}")
+        if self._mode == "continuous" and tier != "beam":
+            raise ValueError(
+                f"continuous serving decodes at the beam tier only (the "
+                f"resident slot state is fixed-beam); got tier={tier!r} "
+                f"— use serve_mode=microbatch for tiered requests")
+        if tier != "beam" and getattr(self._decoder, "sharded", False):
+            raise ValueError(
+                f"sharded (mesh) serving decodes at the beam tier only "
+                f"(the search is jit-built once for the mesh plan); got "
+                f"tier={tier!r}")
+        if tier in ("spec", "draft") and not getattr(
+                self._decoder, "has_draft", False):
+            raise ValueError(
+                f"tier={tier!r} needs a draft model: set hps.spec_draft "
+                f"('map'/'fresh') or construct the decoder with "
+                f"draft_params=")
         example = SummaryExample.build(
             article, [], self._vocab, self._hps,
             uuid=uuid, reference=reference)
@@ -243,7 +291,7 @@ class ServingServer:
             uuid, article, reference, example,
             deadline=Deadline.after(
                 getattr(self._hps, "decode_deadline_secs", 0.0)),
-            registry=self._reg)
+            registry=self._reg, tier=tier)
         self._queue.submit(req, block=block, timeout=timeout)
         return req.future
 
@@ -390,9 +438,41 @@ class ServingServer:
                               "continuing on current params")
                 t_last = time.monotonic()
 
+    #: deadline-pressure re-tiering per REQUESTED tier: beam falls to
+    #: the configured target, spec falls to its verify-free draft;
+    #: greedy/draft are already the floor of their branch
+    def _degrade_target(self, tier: str) -> Optional[str]:
+        if tier == "beam":
+            return self._hps.serve_degrade_tier
+        if tier == "spec":
+            return "draft"
+        return None
+
+    def _effective_tier(self, r: ServeRequest) -> tuple:
+        """(effective tier, degraded?) for one request — the ISSUE-10
+        satellite fix: degradation is decided PER REQUEST against its
+        own deadline, not once for the whole micro-batch, so one
+        tight-deadline member no longer drags its batchmates down to
+        greedy with it."""
+        tier = r.tier or getattr(self._hps, "serve_default_tier", "beam")
+        target = self._degrade_target(tier)
+        if target is None or not self._decoder.should_degrade(r.deadline):
+            return tier, False
+        if target in ("spec", "draft") and not getattr(
+                self._decoder, "has_draft", False):
+            target = "greedy"  # draftless jobs keep the legacy ladder
+        return target, True
+
     def _dispatch(self, group: List[ServeRequest]) -> None:
         now = time.monotonic()
-        live: List[ServeRequest] = []
+        # decoders without the tier surface (should_degrade — legacy
+        # stubs, custom wirings) keep the pre-tier contract: one
+        # whole-group dispatch, degradation decided inside decode_batch
+        legacy = not hasattr(self._decoder, "should_degrade")
+        #: effective tier -> [(request, degraded?)] — a mixed group
+        #: dispatches once per tier (a dispatch runs ONE compiled
+        #: program, so tiers cannot share a device batch)
+        by_tier: dict = {}
         for r in group:
             queue_s = now - r.enqueue_t
             self._h_queue_time.observe(queue_s)
@@ -405,37 +485,57 @@ class ServingServer:
                                         r.uuid, where="queue")
                 r.future._reject(DeadlineExceededError(
                     f"request {r.uuid!r} deadline expired while queued"))
-            else:
+                continue
+            if legacy:
                 obs.spans.request_event(
                     self._reg, "admit", r.trace, r.uuid,
                     queue_ms=round(queue_s * 1e3, 3))
-                live.append(r)
-        group = live
-        if not group:
-            return
+                by_tier.setdefault(None, []).append((r, False))
+                continue
+            tier, degraded = self._effective_tier(r)
+            obs.spans.request_event(
+                self._reg, "admit", r.trace, r.uuid,
+                queue_ms=round(queue_s * 1e3, 3), tier=tier)
+            by_tier.setdefault(tier, []).append((r, degraded))
+        for tier, members in by_tier.items():
+            self._dispatch_tier(tier, members)
+
+    def _dispatch_tier(self, tier: Optional[str],
+                       members: List[tuple]) -> None:
+        """One device dispatch for one tier's sub-group (tier=None is
+        the legacy whole-group path for tier-less decoders — the
+        decoder decides its own degradation from the deadline)."""
+        group = [r for r, _ in members]
+        degraded_map = {id(r): d for r, d in members}
         # micro-batch flight frame (the per-dispatch analogue of the
         # continuous per-tick frame), recorded before the dispatch so a
         # failing batch leaves its own pre-failure frame behind
         flightrec.record(self._reg, "serve_dispatch", fill=len(group),
-                         queue_depth=self._queue.qsize())
+                         queue_depth=self._queue.qsize(),
+                         tier=tier or "legacy")
         try:
             with obs.spans.span(self._reg, "serve/dispatch",
-                                fill=len(group)):
+                                fill=len(group), tier=tier or "legacy"):
                 if self._faults.fire("serve.dispatch"):
                     raise RuntimeError("injected serve.dispatch fault")
                 batch = self._batcher.build(group)
-                results = self._decoder.decode_batch(
-                    batch, deadline=self._tightest_deadline(group))
+                deadline = self._tightest_deadline(group)
+                if tier is None:
+                    results = self._decoder.decode_batch(
+                        batch, deadline=deadline)
+                else:
+                    results = self._decoder.decode_batch(
+                        batch, deadline=deadline, tier=tier)
             if len(results) != len(group):
                 raise RuntimeError(
                     f"decoder returned {len(results)} results for "
                     f"{len(group)} real rows (real_mask drift?)")
         except Exception as e:
-            # a failed dispatch fails ITS batch only — each member
-            # resolves exactly once with the typed cause; the server
-            # lives on to serve the next group
+            # a failed dispatch fails ITS tier sub-batch only — each
+            # member resolves exactly once with the typed cause; the
+            # server lives on to serve the next group
             flightrec.trigger(self._reg, "serve_dispatch",
-                              error=type(e).__name__)
+                              error=type(e).__name__, tier=tier)
             self._c_errors.inc(len(group))
             log.exception("serve dispatch failed; rejecting %d request(s)",
                           len(group))
@@ -444,13 +544,28 @@ class ServingServer:
             return
         done_t = time.monotonic()
         for r, res in zip(group, results):
-            if getattr(res, "degraded", False):
-                self._c_degraded.inc()
+            degraded = degraded_map.get(id(r), False)
+            res.degraded = bool(degraded or getattr(res, "degraded",
+                                                    False))
+            if tier is None:
+                if res.degraded:  # legacy path: the decoder decided
+                    self._c_degraded.inc()
+            elif degraded:
+                # counted HERE, on successful completion, so a failed
+                # sub-dispatch can never report more degraded results
+                # than completions (same semantics as the legacy path)
+                asked = r.tier or getattr(self._hps, "serve_default_tier",
+                                          "beam")
+                self._c_degraded.inc()  # per REQUEST, not per batch
+                if asked in self._c_tier_degraded:
+                    self._c_tier_degraded[asked].inc()
+            if tier in self._c_tier_done:
+                self._c_tier_done[tier].inc()
             self._h_e2e.observe(done_t - r.enqueue_t)
             self._c_done.inc()
             obs.spans.request_event(
                 self._reg, "finish", r.trace, r.uuid,
-                degraded=bool(getattr(res, "degraded", False)))
+                tier=tier or "legacy", degraded=bool(res.degraded))
             r.future._resolve(res)
 
 

@@ -68,6 +68,14 @@ DEFAULT: Dict[str, Any] = {
                 # or trace-time side effect here poisons the whole mesh
                 r"^make_sharded_train_step",
                 r"^_make_wire_grad_fn",
+                # the speculative fast path (ISSUE 10): the draft-verify
+                # cycle body and the parallel verify run once per
+                # emitted-token group, and the AAN decode step once per
+                # draft token — a host sync in any of them serializes
+                # the spec tier back to per-token dispatch
+                r"^_spec_body",  # covers the <locals>.body cycle closure
+                r"^spec_verify",
+                r"^decode_onestep",  # pg + avg_attention decode steps
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
