@@ -44,6 +44,10 @@ Modes (BENCH_MODE):
                     resolved, queue wait included), mean batch fill /
                     slot occupancy, and requests/sec.  `python bench.py
                     --serve` is shorthand for BENCH_MODE=serve;
+                    `--serve-short-ratio=0.875` the bimodal mix's
+                    short-request fraction (BENCH_SERVE_SHORT_RATIO;
+                    fingerprinted only when non-default — ISSUE 11's
+                    disaggregation axis);
                     `--serve-mode=continuous|microbatch` picks the
                     dispatch engine (BENCH_SERVE_MODE) and
                     `--serve-mix=bimodal` the seeded short/long article
@@ -219,6 +223,30 @@ def _obs_extra() -> dict:
     return {"obs_snapshot": obs.snapshot(compact=True)}
 
 
+_BIMODAL_POOL = 32  # articles in the generated bimodal mix (bench_serve)
+
+
+def _bimodal_long_every(short_ratio: float) -> int:
+    """The bimodal mix's long-article cadence for a requested short
+    fraction: every long_every-th request is long."""
+    return max(2, round(1.0 / (1.0 - short_ratio)))
+
+
+def _effective_short_ratio(short_ratio: float) -> float:
+    """The short fraction the generated _BIMODAL_POOL-article mix
+    ACTUALLY has: the cadence quantizes the request (0.6 -> every 2nd
+    long -> a 0.5 mix) AND the finite pool quantizes the cadence
+    (longs sit at indices 0, le, 2le, ... < pool, so 0.8 -> le=5 -> 7
+    longs of 32 -> 0.7812).  Both the published row and the
+    fingerprint must carry the workload that ran, not the one that was
+    asked for — otherwise two asks that generate the identical article
+    list (e.g. any cadence > pool places exactly one long) would carry
+    different fingerprints and one measured mix could stand in for
+    another."""
+    n_long = -(-_BIMODAL_POOL // _bimodal_long_every(short_ratio))
+    return round(1.0 - n_long / _BIMODAL_POOL, 4)
+
+
 def _config_fingerprint() -> dict:
     """The config axes that distinguish one sweep row from another, as
     seen from the environment.  Successful records embed this; the stale
@@ -337,6 +365,20 @@ def _config_fingerprint() -> dict:
         # non-default so pre-existing banked records keep matching.
         if os.environ.get("BENCH_SERVE_TIER", "beam") != "beam":
             fp["tier"] = os.environ["BENCH_SERVE_TIER"]
+        # bimodal short-request fraction (ISSUE 11): a different mix is
+        # a different workload — a 7/8-short measurement must never
+        # stand in for the default 3/4-short ask.  Recorded as the
+        # EFFECTIVE (cadence- and pool-quantized) fraction the mix
+        # actually has, only on the bimodal mix (the ratio has no
+        # effect on other workloads — a stray env var must not split
+        # identical uniform-mix records across fingerprints), and only
+        # when non-default so pre-existing bimodal records keep
+        # matching.
+        if os.environ.get("BENCH_SERVE_MIX", "buckets") == "bimodal":
+            sr = _effective_short_ratio(
+                float(os.environ.get("BENCH_SERVE_SHORT_RATIO", "0.75")))
+            if sr != 0.75:
+                fp["short_ratio"] = sr
     if mode == "decode":
         # while vs scan vs chunked decode loops differ by ~1.4 ms per
         # dynamic iteration on the tunneled backend — never
@@ -1337,14 +1379,29 @@ def bench_serve() -> None:
     vocab = Vocab(words=[f"w{i}" for i in range(n_words)])
     pool = [f"w{i}" for i in range(min(n_words, 2000))]
     buckets = resolve_buckets(hps)
+    # short-request fraction of the bimodal mix (ISSUE 11): default
+    # 0.75 = the historical every-4th-long shape; fingerprinted only
+    # when non-default so banked bimodal records keep matching.  The
+    # row carries the EFFECTIVE (cadence-quantized) fraction — see
+    # _effective_short_ratio.
+    asked_ratio = float(os.environ.get("BENCH_SERVE_SHORT_RATIO",
+                                       "0.75"))
+    if not 0.0 < asked_ratio < 1.0:
+        raise ValueError(
+            f"BENCH_SERVE_SHORT_RATIO must be in (0, 1), got "
+            f"{asked_ratio}")
+    short_ratio = _effective_short_ratio(asked_ratio)
     articles = []
     if mix == "bimodal":
-        # the straggler workload (SERVE_SLO.json shape): every 4th
-        # request a max-length article, the rest short — the load where
-        # the micro-batch dispatch barrier hurts and slot refill wins
+        # the straggler workload (SERVE_SLO.json shape): every
+        # long_every-th request a max-length article, the rest short —
+        # the load where the micro-batch dispatch barrier hurts, slot
+        # refill wins, and (ISSUE 11) disaggregation stops the shorts
+        # from paying the longs' encoder shapes
+        long_every = _bimodal_long_every(asked_ratio)
         short_n = max(4, hps.max_enc_steps // 8)
-        for i in range(32):
-            n = hps.max_enc_steps if i % 4 == 0 else \
+        for i in range(_BIMODAL_POOL):
+            n = hps.max_enc_steps if i % long_every == 0 else \
                 rng.randint(max(short_n // 2, 1), short_n + 1)
             articles.append(" ".join(rng.choice(pool, size=n)))
         rng.shuffle(articles)
@@ -1370,11 +1427,20 @@ def bench_serve() -> None:
         occ_h = reg.histogram("serve/slot_occupancy")
         with server:
             if serve_mode == "continuous":
-                # ONE resident shape: a single request warms all four
-                # slot kernels (init/pack/step/unpack)
-                server.submit(" ".join(pool[i % len(pool)]
-                                       for i in range(hps.max_enc_steps)),
-                              uuid="warm").result(timeout=1200)
+                # the decode kernels warm on the first request (ONE
+                # resident shape: init/pack/step/unpack), but prefill
+                # compiles once per BUCKET (ISSUE 11) — warm every
+                # bucket with an exactly-b-word article so no prefill
+                # compile lands in the timed run.  Submitted together:
+                # the slot engine decodes the warmers concurrently, so
+                # warmup costs ~one decode, not len(buckets) decodes
+                warm_futs = [
+                    server.submit(
+                        " ".join(pool[i % len(pool)] for i in range(b)),
+                        uuid=f"warm{b}")
+                    for b in buckets]
+                for f in warm_futs:
+                    f.result(timeout=1200)
             else:
                 for b in buckets:  # compile every bucket before timing
                     # exactly b words -> enc_len == b -> bucket_for
@@ -1390,6 +1456,7 @@ def bench_serve() -> None:
             # published row must carry the TIMED run only, on one
             # measurement basis
             refills0 = reg.counter("serve/slot_refills_total").value
+            prefill0 = reg.counter("serve/prefill_total").value
             evict0 = reg.counter("serve/deadline_evictions_total").value
             shed0 = reg.counter("serve/shed_total").value
             degraded0 = reg.counter("serve/degraded_total").value
@@ -1476,12 +1543,17 @@ def bench_serve() -> None:
             "serve_mode": serve_mode,
             "tier": tier,
             "mix": mix,
+            "short_ratio": short_ratio if mix == "bimodal" else None,
             "batch_fill_mean": round(fill_mean, 2),
             "occupancy_mean": round(occupancy, 3),
             "batches": n_batches,
             "chunks": n_chunks,
             "slot_refills_total": int(
                 reg.counter("serve/slot_refills_total").value - refills0),
+            # the disaggregation evidence (ISSUE 11): timed requests
+            # through the bucketed prefill stage (0 in microbatch mode)
+            "prefill_total": int(
+                reg.counter("serve/prefill_total").value - prefill0),
             "deadline_evictions_total": int(
                 reg.counter("serve/deadline_evictions_total").value
                 - evict0),
@@ -1811,6 +1883,9 @@ if __name__ == "__main__":
         elif arg.startswith("--serve-tier="):
             os.environ["BENCH_MODE"] = "serve"
             os.environ["BENCH_SERVE_TIER"] = arg.split("=", 1)[1]
+        elif arg.startswith("--serve-short-ratio="):
+            os.environ["BENCH_MODE"] = "serve"
+            os.environ["BENCH_SERVE_SHORT_RATIO"] = arg.split("=", 1)[1]
     if os.environ.get("TS_BENCH_CHILD") == "1":
         child_main()
     else:

@@ -62,8 +62,10 @@ python scripts/mesh_smoke.py
 echo "== serve smoke (CollectionSource -> ServingServer -> CollectionSink)"
 # the concurrent serving path (SERVING.md) over the 8 synthetic rows,
 # BOTH dispatch engines: micro-batch (queue admission, coalescing,
-# bucket padding) and continuous (slot refill at chunk boundaries),
-# with row-for-row parity asserted between them
+# bucket padding) and continuous — which now runs the ISSUE-11
+# DISAGGREGATED path (mixed-length articles through the bucketed
+# prefill stage into length-masked slots) — with row-for-row parity
+# asserted between the two engines and the prefill telemetry checked
 python scripts/serve_smoke.py
 
 echo "== speculative-tier smoke (draft init -> spec decode -> exactness)"
@@ -96,6 +98,21 @@ echo "== continuous-mode serve load smoke (bimodal mix)"
 # the enforced scheduling claim; this proves the real-model path runs)
 BENCH_MODE=serve BENCH_PLATFORM=cpu BENCH_PRESET=tiny \
   BENCH_SERVE_MODE=continuous BENCH_SERVE_MIX=bimodal \
+  BENCH_SERVE_REQS=8 BENCH_SERVE_CONCURRENCY=4 BENCH_ATTEMPTS=1 \
+  BENCH_STALE_FILE="$T/all.jsonl" \
+  python bench.py 2>/dev/null | tail -1
+
+echo "== prefill/decode disaggregation smoke (short-heavy bimodal mix)"
+# the ISSUE-11 path under the load it exists for: a NON-default
+# short-request ratio (7/8 short — fingerprinted via the short_ratio
+# axis) through the continuous engine, so the row carries
+# prefill_total > 0 and the bucketed-prefill + length-masked slot
+# machinery runs end to end on a real model (the enforced claims live
+# in BYTE_BUDGET.json decode.length_axis/prefill and SERVE_SLO.json
+# disaggregated, both in the suite above)
+BENCH_MODE=serve BENCH_PLATFORM=cpu BENCH_PRESET=tiny \
+  BENCH_SERVE_MODE=continuous BENCH_SERVE_MIX=bimodal \
+  BENCH_SERVE_SHORT_RATIO=0.875 \
   BENCH_SERVE_REQS=8 BENCH_SERVE_CONCURRENCY=4 BENCH_ATTEMPTS=1 \
   BENCH_STALE_FILE="$T/all.jsonl" \
   python bench.py 2>/dev/null | tail -1

@@ -2,7 +2,12 @@
 the 8 synthetic rows (TensorFlowTest.createArticleData shape), tiny
 model, CPU — the no-hardware proof that the concurrent serving path
 (queue admission, micro-batching, bucket padding, future resolution,
-sink fan-in) works end to end.  Wired into scripts/repro.sh.
+sink fan-in) works end to end.  The continuous pass runs the
+DISAGGREGATED path (ISSUE 11): mixed-length articles route through the
+bucketed prefill stage into length-masked slots, with row-for-row
+parity asserted against the single-stage micro-batch pass and the
+prefill telemetry checked (every request prefilled, short articles at
+sub-max buckets).  Wired into scripts/repro.sh.
 """
 
 import os
@@ -26,14 +31,22 @@ from textsummarization_on_flink_tpu.train import trainer  # noqa: E402
 
 
 def main() -> None:
-    rows = [(f"uuid-{i}", f"article {i} .", "", f"reference {i} .")
+    # mixed LENGTHS on purpose (ISSUE 11): even rows are short (3-word)
+    # articles that bucket at 8, odd rows pad out toward the top bucket
+    # — the continuous pass must route them to different prefill shapes
+    # while staying row-identical with the micro-batch pass
+    rows = [(f"uuid-{i}",
+             f"article {i} ." if i % 2 == 0
+             else f"article {i} " + ". article " * 5 + ".",
+             "", f"reference {i} .")
             for i in range(8)]
     vocab = Vocab(words=["article", "reference", ".", "0", "1", "2", "3",
                          "4", "5", "6", "7"])
     hps = HParams(mode="decode", batch_size=2, hidden_dim=16, emb_dim=8,
                   vocab_size=vocab.size(), max_enc_steps=16, max_dec_steps=6,
                   beam_size=2, min_dec_steps=1, max_oov_buckets=4,
-                  serve_max_wait_ms=50.0, serve_max_queue=32)
+                  serve_max_wait_ms=50.0, serve_max_queue=32,
+                  serve_buckets="8,16")
     params = trainer.init_train_state(hps, vocab.size(), seed=0).params
 
     # micro-batch mode (the ISSUE-4 baseline)
@@ -65,10 +78,25 @@ def main() -> None:
     assert by_uuid == by_uuid_c, "continuous/micro-batch row drift"
     reg = obs.registry()
     occ = reg.histogram("serve/slot_occupancy")
+    # prefill/decode disaggregation evidence (ISSUE 11): every request
+    # went through the bucketed prefill stage, and the short rows
+    # really ran their encoder pass at the SUB-MAX bucket (a bucket
+    # histogram pinned at max_enc_steps would mean the stage pads
+    # everything to full width again)
+    prefills = int(reg.counter("serve/prefill_total").value)
+    bucket_h = reg.histogram("serve/prefill_bucket_len")
+    assert prefills == 8, f"expected 8 prefills, saw {prefills}"
+    assert bucket_h.count == 8
+    assert bucket_h.mean < hps.max_enc_steps, (
+        f"mean prefill bucket {bucket_h.mean:.1f} pinned at "
+        f"max_enc_steps={hps.max_enc_steps}: short articles are not "
+        f"routing to short encoder shapes")
     print(f"continuous smoke OK: 8 rows over {occ.count} chunk step(s), "
           f"mean occupancy {occ.mean:.2f}, "
           f"refills {int(reg.counter('serve/slot_refills_total').value)}, "
-          f"rows identical to micro-batch")
+          f"prefills {prefills} (mean bucket {bucket_h.mean:.1f} of "
+          f"{hps.max_enc_steps}), rows identical to micro-batch "
+          f"(disaggregated prefill/decode path)")
 
 
 if __name__ == "__main__":

@@ -168,11 +168,35 @@ def test_backtrack_matches_mirror_no_early_exit(family_name, hps):
         assert_matches_mirror(out, b, ref)
 
 
+def _drive_slots(params, hps, state, slots, chunk=3, max_chunks=16):
+    active = np.ones(slots, bool)
+    done = {}
+    for _ in range(max_chunks):
+        state, fin = beam_search.step_slots_jit(params, hps, state,
+                                                active, chunk)
+        for s in np.nonzero(np.asarray(fin))[0]:
+            done[int(s)] = beam_search.unpack_slot_jit(hps, state, int(s))
+            active[s] = False
+        if not active.any():
+            break
+    return done
+
+
+def _assert_slot_matches_mirror(out, ref):
+    n = int(out.length)
+    assert list(np.asarray(out.tokens)[:n]) == ref.tokens
+    np.testing.assert_allclose(np.asarray(out.avg_log_prob), ref.avg,
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(out.attn_dists)[:n - 1],
+                               np.stack(ref.attn), rtol=1e-5, atol=1e-6)
+
+
 @pytest.mark.parametrize("family_name,hps", FAMILY_CASES)
 def test_slot_kernels_match_materialized_mirror(family_name, hps):
     """The slot kernels (continuous serving) run the same backpointer
-    body per resident article: pack -> chunked steps -> unpack must
-    match the materialized mirror exactly, for both families."""
+    body per resident article: prefill -> pack -> chunked steps ->
+    unpack must match the materialized mirror exactly, for both
+    families (and the AAN draft tier)."""
     family = get_family(family_name)
     params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(3))
     arrays = make_arrays(hps, seed=6)
@@ -182,27 +206,83 @@ def test_slot_kernels_match_materialized_mirror(family_name, hps):
     state = beam_search.init_slots_jit(params, hps, zero)
     for slot in range(slots):
         one = {k: v[slot:slot + 1] for k, v in arrays.items()}
-        state = beam_search.pack_slot_jit(params, hps, state, slot, one)
-    active = np.ones(slots, bool)
-    done = {}
-    for _ in range(16):
-        state, fin = beam_search.step_slots_jit(params, hps, state,
-                                                active, 3)
-        for s in np.nonzero(np.asarray(fin))[0]:
-            done[int(s)] = beam_search.unpack_slot_jit(hps, state, int(s))
-            active[s] = False
-        if not active.any():
-            break
+        state = beam_search.pack_slot_jit(
+            params, hps, state, slot,
+            beam_search.prefill_jit(params, hps, one))
+    done = _drive_slots(params, hps, state, slots)
     assert sorted(done) == list(range(slots))
     for b in range(slots):
-        out = done[b]
         ref = materialized_search(params, hps, family, arrays, b)
-        n = int(out.length)
-        assert list(np.asarray(out.tokens)[:n]) == ref.tokens
-        np.testing.assert_allclose(np.asarray(out.avg_log_prob), ref.avg,
-                                   rtol=2e-5, atol=2e-6)
-        np.testing.assert_allclose(np.asarray(out.attn_dists)[:n - 1],
-                                   np.stack(ref.attn), rtol=1e-5, atol=1e-6)
+        _assert_slot_matches_mirror(done[b], ref)
+
+
+# -- prefill/decode disaggregation parity (ISSUE 11) -----------------------
+#
+# The mirror is the FULL-WIDTH dense search; the slot path now prefills
+# each article at its BUCKET shape and decodes with the valid-length
+# mask and the blocked (conditional-chain) cross-attention.  Exactness
+# across bucket lengths is the claim that disaggregation changed the
+# COST story, not the numerics: the encoders are pad-invariant, the
+# padded encoder tail sits behind the valid-length mask, and an
+# uncovered key block's energies land on the same masked floor dense
+# padding does.
+
+#: articles engineered at the satellite's edge cases, as true lengths
+#: against buckets (4, 8, 12) at the 12-wide test scale: a 1-token
+#: article, one exactly AT a bucket boundary, one mid-bucket, and one
+#: at the top bucket — packed together (mixed-length occupancy).
+_DISAGG_LENS = (1, 4, 7, 12)
+_DISAGG_BUCKETS = (4, 8, 12)
+
+
+def _arrays_with_lens(hps, lens, seed=0):
+    arrays = make_arrays(hps, seed=seed, B=len(lens))
+    T = hps.max_enc_steps
+    lens = np.asarray(lens, np.int32)
+    mask = (np.arange(T)[None, :] < lens[:, None]).astype(np.float32)
+    arrays["enc_lens"] = lens
+    arrays["enc_padding_mask"] = mask
+    arrays["enc_batch"] = (arrays["enc_batch"] * mask).astype(np.int32)
+    ext = arrays["enc_batch_extend_vocab"]
+    arrays["enc_batch_extend_vocab"] = np.where(mask > 0, ext,
+                                                0).astype(np.int32)
+    return arrays
+
+
+@pytest.mark.parametrize("family_name,hps", FAMILY_CASES)
+def test_bucketed_prefill_matches_mirror_at_every_length(family_name, hps):
+    """Mixed-length slot occupancy through the DISAGGREGATED path:
+    each article prefilled at its own bucket (1-token -> bucket 4,
+    boundary article -> its exact bucket, top-length article -> the
+    resident width), decoded together under the blocked cross-attention
+    in the multi-block regime (decode_enc_block=4 at T_enc=12), and
+    every trajectory must still match the full-width materialized
+    mirror token-exactly."""
+    hps = hps.replace(batch_size=len(_DISAGG_LENS), decode_enc_block=4)
+    family = get_family(family_name)
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(3))
+    arrays = _arrays_with_lens(hps, _DISAGG_LENS, seed=6)
+    slots = len(_DISAGG_LENS)
+    zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
+            for k, v in arrays.items()}
+    state = beam_search.init_slots_jit(params, hps, zero)
+    for slot, true_len in enumerate(_DISAGG_LENS):
+        bucket = next(b for b in _DISAGG_BUCKETS if true_len <= b)
+        one = {k: (v[slot:slot + 1, :bucket] if v.ndim == 2
+                   else v[slot:slot + 1])
+               for k, v in arrays.items()}
+        pre = beam_search.prefill_jit(params, hps, one)
+        assert int(np.asarray(pre.enc_valid_len)[0]) == true_len
+        state = beam_search.pack_slot_jit(params, hps, state, slot, pre)
+    # the resident state records every article's TRUE length, not its
+    # bucket or the padded width
+    np.testing.assert_array_equal(
+        np.asarray(state.enc_valid_len), np.asarray(_DISAGG_LENS))
+    done = _drive_slots(params, hps, state, slots)
+    assert sorted(done) == list(range(slots))
+    for b in range(slots):
+        ref = materialized_search(params, hps, family, arrays, b)
+        _assert_slot_matches_mirror(done[b], ref)
 
 
 class TestBf16KVCache:
@@ -300,7 +380,8 @@ def test_finalize_adds_at_most_one_compile_to_warm_set():
     before = {f: f._cache_size() for f in kernels}
     state = beam_search.init_slots_jit(params, hps, zero)
     one = {k: v[0:1] for k, v in arrays.items()}
-    state = beam_search.pack_slot_jit(params, hps, state, 0, one)
+    state = beam_search.pack_slot_jit(
+        params, hps, state, 0, beam_search.prefill_jit(params, hps, one))
     state, _ = beam_search.step_slots_jit(params, hps, state,
                                           np.array([True, False]), 2)
     beam_search.unpack_slot_jit(hps, state, 0)
@@ -308,3 +389,58 @@ def test_finalize_adds_at_most_one_compile_to_warm_set():
               for f in kernels}
     assert growth == {"init_slots_jit": 1, "pack_slot_jit": 1,
                       "step_slots_jit": 1, "unpack_slot_jit": 1}, growth
+
+
+def test_warm_set_is_four_plus_one_prefill_per_bucket():
+    """The ISSUE 11 compile-count pin: a fresh config warms the engine
+    with exactly FOUR decode compiles (init/pack/step/unpack — slot
+    index, occupancy, and valid length all traced) plus ONE prefill
+    compile per bucket actually used — and after that warm set, no
+    occupancy pattern, slot choice, article length, or length MIX
+    recompiles anything."""
+    # a config no other test compiles, so cache deltas are attributable
+    hps = PG_HPS.replace(max_oov_buckets=6, beam_size=2,
+                         decode_enc_block=4, batch_size=3)
+    family = get_family("pointer_generator")
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(2))
+    arrays = _arrays_with_lens(hps, (2, 7, 12), seed=5)
+    slots = 3
+    zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
+            for k, v in arrays.items()}
+    kernels = (beam_search.init_slots_jit, beam_search.pack_slot_jit,
+               beam_search.step_slots_jit, beam_search.unpack_slot_jit,
+               beam_search.prefill_jit)
+    before = {f: f._cache_size() for f in kernels}
+
+    def pre_at(slot, bucket):
+        one = {k: (v[slot:slot + 1, :bucket] if v.ndim == 2
+                   else v[slot:slot + 1])
+               for k, v in arrays.items()}
+        return beam_search.prefill_jit(params, hps, one)
+
+    buckets = (4, 8, 12)
+    state = beam_search.init_slots_jit(params, hps, zero)
+    for slot, bucket in enumerate(buckets):  # warm every bucket
+        state = beam_search.pack_slot_jit(params, hps, state, slot,
+                                          pre_at(slot, bucket))
+    state, _ = beam_search.step_slots_jit(
+        params, hps, state, np.array([True, True, True]), 2)
+    beam_search.unpack_slot_jit(hps, state, 1)
+    growth = {f.__wrapped__.__name__: f._cache_size() - before[f]
+              for f in kernels}
+    assert growth == {"init_slots_jit": 1, "pack_slot_jit": 1,
+                      "step_slots_jit": 1, "unpack_slot_jit": 1,
+                      "prefill_jit": len(buckets)}, growth
+    warm = {f: f._cache_size() for f in kernels}
+    # churn: different slots, buckets, occupancy patterns, length mixes
+    state = beam_search.pack_slot_jit(params, hps, state, 1,
+                                      pre_at(0, 4))
+    state, _ = beam_search.step_slots_jit(
+        params, hps, state, np.array([False, True, True]), 2)
+    state = beam_search.pack_slot_jit(params, hps, state, 0,
+                                      pre_at(2, 8))
+    state, _ = beam_search.step_slots_jit(
+        params, hps, state, np.array([True, False, False]), 2)
+    beam_search.unpack_slot_jit(hps, state, 0)
+    for f, n in warm.items():
+        assert f._cache_size() == n, f.__wrapped__.__name__

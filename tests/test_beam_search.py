@@ -253,7 +253,9 @@ class TestSlotSearch:
         placement = {2: 0, 0: 1}  # slot -> article
         for slot, art in placement.items():
             one = {k: v[art:art + 1] for k, v in arrays.items()}
-            state = beam_search.pack_slot_jit(params, HPS, state, slot, one)
+            state = beam_search.pack_slot_jit(
+                params, HPS, state, slot,
+                beam_search.prefill_jit(params, HPS, one))
         _, done, _ = self._drive(params, HPS, state,
                                  [True, False, True], chunk=3)
         assert sorted(done) == sorted(placement)
@@ -281,7 +283,9 @@ class TestSlotSearch:
                 for k, v in arrays.items()}
         state = beam_search.init_slots_jit(params, HPS, zero)
         one = {k: v[0:1] for k, v in arrays.items()}
-        state = beam_search.pack_slot_jit(params, HPS, state, 1, one)
+        state = beam_search.pack_slot_jit(
+            params, HPS, state, 1,
+            beam_search.prefill_jit(params, HPS, one))
         state, fin = beam_search.step_slots_jit(
             params, HPS, state, np.array([False, True]), 2)
         assert not bool(np.asarray(fin)[0])  # inactive slot stays silent
@@ -299,7 +303,9 @@ class TestSlotSearch:
                                                     active, 2)
         assert 1 in done
         two = {k: v[1:2] for k, v in arrays.items()}
-        state = beam_search.pack_slot_jit(params, HPS, state, 1, two)
+        state = beam_search.pack_slot_jit(
+            params, HPS, state, 1,
+            beam_search.prefill_jit(params, HPS, two))
         _, done2, _ = self._drive(params, HPS, state, [False, True], chunk=2)
         out = done2[1]
         n = int(out.length)
@@ -317,7 +323,9 @@ class TestSlotSearch:
                 for k, v in arrays.items()}
         state = beam_search.init_slots_jit(params, HPS, zero)
         one = {k: v[0:1] for k, v in arrays.items()}
-        state = beam_search.pack_slot_jit(params, HPS, state, 0, one)
+        state = beam_search.pack_slot_jit(
+            params, HPS, state, 0,
+            beam_search.prefill_jit(params, HPS, one))
         state, _ = beam_search.step_slots_jit(
             params, HPS, state, np.array([True, False, False]), 3)
         beam_search.unpack_slot_jit(HPS, state, 0)
@@ -327,7 +335,9 @@ class TestSlotSearch:
                            beam_search.unpack_slot_jit)}
         for slot, art in ((1, 1), (2, 0), (0, 1)):
             nxt = {k: v[art:art + 1] for k, v in arrays.items()}
-            state = beam_search.pack_slot_jit(params, HPS, state, slot, nxt)
+            state = beam_search.pack_slot_jit(
+                params, HPS, state, slot,
+                beam_search.prefill_jit(params, HPS, nxt))
         state, _ = beam_search.step_slots_jit(
             params, HPS, state, np.array([True, True, True]), 3)
         beam_search.unpack_slot_jit(HPS, state, 2)

@@ -29,6 +29,7 @@ from __graft_entry__ import (
     decode_state_bytes,
     decode_step_cost,
     decode_step_flops,
+    prefill_cost,
     train_step_comms,
     train_step_cost,
 )
@@ -218,6 +219,136 @@ def test_decode_peak_temp_floors_hold(budget, decode_measured, family, kind):
         f"{family}/{kind}: decode peak-temp reduction vs the pre-PR "
         f"baseline fell to {reduction:.1%} (committed floor {floor:.1%}) — "
         f"the trajectory buffers are materializing again")
+
+
+# --------------------------------------------------------------------------
+# Prefill/decode disaggregation gate (ISSUE 11)
+# --------------------------------------------------------------------------
+#
+# Two committed claims (BYTE_BUDGET.json decode.length_axis /
+# decode.prefill): (1) the length-masked slot chunk's cost scales with
+# the longest active resident's TRUE article length (the traced block
+# chain — decode_step_cost's enc_len axis prices exactly the blocks the
+# served program executes at that length); (2) the prefill stage's
+# encoder work scales with the article's BUCKET instead of the full
+# max_enc_steps every admission used to pay.
+
+_DISAGG_FAMILIES = ("pointer_generator", "transformer")
+
+
+@pytest.fixture(scope="module")
+def length_axis_measured(budget):
+    la = budget["decode"]["length_axis"]
+    chunk = int(budget["decode"]["chunk"])
+    out = {}
+    for family in _DISAGG_FAMILIES:
+        hps = _decode_hps(budget, family).replace(
+            decode_enc_block=int(la["enc_block"]))
+        out[family] = {
+            int(L): decode_step_cost(hps, path="slot", chunk=chunk,
+                                     enc_len=int(L))
+            for L in la["lengths"]
+        }
+    return out
+
+
+@pytest.fixture(scope="module")
+def prefill_measured(budget):
+    pf = budget["decode"]["prefill"]
+    out = {}
+    for family in _DISAGG_FAMILIES:
+        hps = _decode_hps(budget, family)
+        out[family] = {int(b): prefill_cost(hps, int(b))
+                       for b in pf["buckets"]}
+    return out
+
+
+@pytest.mark.parametrize("family", _DISAGG_FAMILIES)
+def test_length_axis_bytes_within_budgets(budget, length_axis_measured,
+                                          family):
+    budgets = budget["decode"]["length_axis"]["budgets"][family]
+    over = {
+        L: (c["bytes_per_token"], budgets["max_bytes_per_token"][str(L)])
+        for L, c in length_axis_measured[family].items()
+        if c["bytes_per_token"] > budgets["max_bytes_per_token"][str(L)]
+    }
+    assert not over, (
+        f"{family}: masked-slot bytes/token past the committed budget at "
+        f"{over} (see BYTE_BUDGET.json decode.length_axis._comment)")
+
+
+@pytest.mark.parametrize("family", _DISAGG_FAMILIES)
+def test_length_axis_cost_scales_with_true_length(budget,
+                                                  length_axis_measured,
+                                                  family):
+    """The acceptance claim: a chunk whose longest active resident is a
+    T_enc/4 (or T_enc/2) article costs at most the committed ratio of
+    the full-length chunk — cost follows TRUE length, not padding."""
+    la = budget["decode"]["length_axis"]
+    full_len = max(int(L) for L in la["lengths"])
+    full = length_axis_measured[family][full_len]["bytes_per_token"]
+    for L, ceiling in la["budgets"][family]["max_ratio_vs_full"].items():
+        ratio = length_axis_measured[family][int(L)]["bytes_per_token"] \
+            / full
+        assert ratio <= ceiling, (
+            f"{family}: masked-slot bytes/token at length {L} is "
+            f"{ratio:.3f}x the full-length chunk (committed max "
+            f"{ceiling}) — decode cost is following padding again")
+
+
+@pytest.mark.parametrize("family", _DISAGG_FAMILIES)
+def test_length_axis_beats_uniform_padding_baseline(budget,
+                                                    length_axis_measured,
+                                                    family):
+    """Reduction floors vs the PRE-CHANGE uniform-padding slot step
+    (every resident paid full-width cross-attention regardless of
+    article length, measured before disaggregation landed)."""
+    la = budget["decode"]["length_axis"]
+    uniform = la["uniform_baseline"][family]
+    floors = la["budgets"][family]["min_reduction_vs_uniform"]
+    for L, floor in floors.items():
+        got = length_axis_measured[family][int(L)]["bytes_per_token"]
+        reduction = 1.0 - got / uniform
+        assert reduction >= floor, (
+            f"{family}: masked-slot reduction vs the uniform-padding "
+            f"baseline at length {L} fell to {reduction:.1%} (committed "
+            f"floor {floor:.1%})")
+
+
+@pytest.mark.parametrize("family", _DISAGG_FAMILIES)
+def test_length_axis_is_monotone(length_axis_measured, family):
+    """Longer max-active-resident lengths can only cost more — the
+    block chain has no pathological cliffs."""
+    costs = [length_axis_measured[family][L]["bytes_per_token"]
+             for L in sorted(length_axis_measured[family])]
+    assert costs == sorted(costs), costs
+
+
+@pytest.mark.parametrize("family", _DISAGG_FAMILIES)
+def test_prefill_cost_scales_with_bucket(budget, prefill_measured, family):
+    """Quarter-bucket prefill under the committed ratios of the
+    pre-change full-width pack (encoder at max_enc_steps on EVERY
+    admission) — bytes AND flops — plus monotonicity in the bucket."""
+    pf = budget["decode"]["prefill"]
+    base = pf["uniform_pack_baseline"][family]
+    limits = pf["budgets"][family]
+    quarter = min(prefill_measured[family])
+    got = prefill_measured[family][quarter]
+    byte_ratio = got["bytes"] / base["bytes"]
+    flops_ratio = got["flops"] / base["flops"]
+    assert byte_ratio <= limits["max_bytes_ratio_quarter"], (
+        f"{family}: quarter-bucket prefill bytes are {byte_ratio:.3f}x "
+        f"the pre-change full-width pack (committed max "
+        f"{limits['max_bytes_ratio_quarter']}) — the encoder stage is "
+        f"paying padded width again")
+    assert flops_ratio <= limits["max_flops_ratio_quarter"], (
+        f"{family}: quarter-bucket prefill flops are {flops_ratio:.3f}x "
+        f"the pre-change full-width pack (committed max "
+        f"{limits['max_flops_ratio_quarter']})")
+    buckets = sorted(prefill_measured[family])
+    for axis in ("bytes", "flops"):
+        vals = [prefill_measured[family][b][axis] for b in buckets]
+        assert vals == sorted(vals), (family, axis, vals)
 
 
 # --------------------------------------------------------------------------

@@ -113,6 +113,43 @@ class StubEngine:
         self._active[idx] = False
 
 
+class PrefillStubEngine(StubEngine):
+    """StubEngine with the disaggregated prefill surface (ISSUE 11):
+    the ContinuousBatcher routes requests through prefill() into its
+    ready queue before pack().  `fail_for` injects a prefill failure
+    for matching uuids (the blast-radius tests)."""
+
+    class Handle:
+        def __init__(self, example, bucket):
+            self.example = example
+            self.bucket = bucket
+
+    def __init__(self, *args, fail_for=None, **kw):
+        super().__init__(*args, **kw)
+        self._fail_for = fail_for or (lambda ex: False)
+        self.prefills = 0
+        self.prefills_before_first_unpack = None
+        self.unpacks = 0
+
+    def prefill(self, example):
+        if self._fail_for(example):
+            raise RuntimeError(f"injected prefill failure for "
+                               f"{example.uuid!r}")
+        self.prefills += 1
+        return self.Handle(example, bucket=example.enc_len)
+
+    def pack(self, idx, handle):
+        assert isinstance(handle, self.Handle), \
+            "prefill engines must be packed from the prefill queue"
+        super().pack(idx, handle.example)
+
+    def unpack(self, idx, example):
+        if self.unpacks == 0:
+            self.prefills_before_first_unpack = self.prefills
+        self.unpacks += 1
+        return super().unpack(idx, example)
+
+
 class StubDecoder:
     """decode_batch-compatible stub: optional per-batch delay, results
     echo the batch's real rows (one per real_mask=True slot).  Mirrors
@@ -700,6 +737,162 @@ class TestContinuousServingStub:
         assert all(f.done() for f in futs)
         assert [f.result(0.1).uuid for f in futs] == \
             [f"u{i}" for i in range(6)]
+
+
+class TestContinuousPrefillStub:
+    """The ContinuousBatcher prefill queue (ISSUE 11), stub engine:
+    routing, telemetry, lookahead, and failure blast radius — no jax."""
+
+    def test_requests_route_through_prefill_exactly_once(
+            self, _isolated_obs):
+        hps, vocab = cont_hps(), make_vocab()
+        engine = PrefillStubEngine(slots=2, chunks_for=lambda ex: 2)
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               engine=engine, registry=_isolated_obs)
+        with server:
+            futs = [server.submit("the cat sat .", uuid=f"u{i}")
+                    for i in range(8)]
+            results = [f.result(timeout=30) for f in futs]
+        assert [r.uuid for r in results] == [f"u{i}" for i in range(8)]
+        assert engine.prefills == 8
+        assert _isolated_obs.counter("serve/prefill_total").value == 8
+        assert _isolated_obs.counter("serve/prefill_errors_total").value == 0
+        bucket_h = _isolated_obs.histogram("serve/prefill_bucket_len")
+        assert bucket_h.count == 8
+
+    def test_prefill_lookahead_runs_ahead_of_free_slots(
+            self, _isolated_obs):
+        """serve_prefill_depth=2 on a 1-slot engine: the first tick
+        packs one request and prefills TWO more ahead of it, so a freed
+        slot refills from an already-encoded article."""
+        hps, vocab = cont_hps(serve_slots=1,
+                              serve_prefill_depth=2), make_vocab()
+        engine = PrefillStubEngine(slots=1, chunks_for=lambda ex: 3)
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               engine=engine, registry=_isolated_obs)
+        # everything enqueued BEFORE the dispatch thread exists, so the
+        # first tick's prefill target (1 free + depth 2) is deterministic
+        futs = [server.submit("the cat sat .", uuid=f"u{i}")
+                for i in range(4)]
+        with server:
+            results = [f.result(timeout=30) for f in futs]
+        assert [r.uuid for r in results] == [f"u{i}" for i in range(4)]
+        assert engine.prefills_before_first_unpack == 3
+
+    def test_prefill_failure_rejects_its_request_only(self, _isolated_obs):
+        """A prefill failure resolves ITS request typed and rides the
+        standard dispatch-failure path (fail_resident blast radius);
+        the server lives on and later requests serve normally."""
+        hps, vocab = cont_hps(), make_vocab()
+        engine = PrefillStubEngine(
+            slots=2, chunks_for=lambda ex: 1,
+            fail_for=lambda ex: ex.uuid == "boom")
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               engine=engine, registry=_isolated_obs)
+        with server:
+            bad = server.submit("the cat sat .", uuid="boom")
+            with pytest.raises(RuntimeError, match="injected prefill"):
+                bad.result(timeout=30)
+            ok = server.submit("the dog ran .", uuid="ok")
+            assert ok.result(timeout=30).uuid == "ok"
+        assert _isolated_obs.counter("serve/prefill_errors_total").value \
+            == 1
+        assert _isolated_obs.counter("serve/completed_total").value == 1
+
+    def test_drain_waits_for_prefilled_backlog(self, _isolated_obs):
+        """The drain-condition regression: a tick can harvest EVERY
+        resident right after the prefill stage drained the queue's tail
+        into the prefill queue — the loop must keep ticking for those
+        admitted-but-unslotted requests (busy() is false, pending() is
+        true), not let stop() reject them."""
+        from textsummarization_on_flink_tpu.serve.batcher import (
+            ContinuousBatcher,
+        )
+
+        hps, vocab = cont_hps(serve_slots=1,
+                              serve_prefill_depth=2), make_vocab()
+        engine = PrefillStubEngine(slots=1, chunks_for=lambda ex: 1)
+        q = RequestQueue(8, registry=_isolated_obs)
+        cont = ContinuousBatcher(hps, q, engine, registry=_isolated_obs)
+        reqs = [make_request(hps, vocab, uuid=f"u{i}") for i in range(3)]
+        for r in reqs:
+            q.submit(r)
+        # tick 1: prefill pops ALL THREE (1 free + depth 2), packs one,
+        # its single chunk finishes and harvests -> no residents, empty
+        # queue, but two prefilled entries pending
+        assert cont.tick(poll=0.01)
+        assert q.empty() and not cont.busy()
+        assert cont.pending()  # the server's drain condition keys on this
+        assert cont.tick(poll=0.01)
+        assert cont.tick(poll=0.01)
+        assert not cont.pending()
+        for r in reqs:
+            assert r.future.result(timeout=1).uuid == r.uuid
+
+    def test_stop_drains_prefilled_backlog_through_server(
+            self, _isolated_obs):
+        """Server-level: stop() right after submit must still resolve
+        every admitted request with a RESULT (the exactly-once drain
+        contract), including ones sitting in the prefill queue when the
+        stop flag lands."""
+        hps, vocab = cont_hps(serve_slots=1,
+                              serve_prefill_depth=2), make_vocab()
+        engine = PrefillStubEngine(slots=1, chunks_for=lambda ex: 1)
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               engine=engine, registry=_isolated_obs)
+        server.start()
+        futs = [server.submit("the cat .", uuid=f"u{i}") for i in range(5)]
+        server.stop()
+        assert [f.result(0.1).uuid for f in futs] == \
+            [f"u{i}" for i in range(5)]
+
+    def test_fail_pending_resolves_prefilled_backlog(self, _isolated_obs):
+        """The shutdown backstop: prefilled-but-unslotted entries must
+        resolve exactly once if the loop dies with them queued."""
+        from textsummarization_on_flink_tpu.serve.batcher import (
+            ContinuousBatcher,
+        )
+
+        hps, vocab = cont_hps(), make_vocab()
+        engine = PrefillStubEngine(slots=1)
+        cont = ContinuousBatcher(hps, RequestQueue(8,
+                                                   registry=_isolated_obs),
+                                 engine, registry=_isolated_obs)
+        req = make_request(hps, vocab, uuid="stranded")
+        cont._prefilled.append((req, engine.prefill(req.example)))
+        n = cont.fail_pending(ServeClosedError("stopped"))
+        assert n == 1
+        with pytest.raises(ServeClosedError):
+            req.future.result(timeout=1)
+
+    def test_prefill_trace_event_carries_bucket(self, tmp_path,
+                                                _isolated_obs):
+        import json
+
+        reg = _isolated_obs
+        sink = obs.install_event_sink(str(tmp_path), flush_secs=0.05,
+                                      reg=reg)
+        hps, vocab = cont_hps(), make_vocab()
+        engine = PrefillStubEngine(slots=2, chunks_for=lambda ex: 1)
+        server = ServingServer(hps, vocab, decoder=StubDecoder(),
+                               engine=engine, registry=reg)
+        with server:
+            server.submit("the cat sat .", uuid="u0").result(timeout=30)
+        sink.close()
+        recs = [json.loads(ln)
+                for ln in open(tmp_path / "events.jsonl",
+                               encoding="utf-8")]
+        events = [r for r in recs if r.get("kind") == "request"
+                  and r["uuid"] == "u0"]
+        stages = [e["event"] for e in events]
+        # the disaggregated lifecycle, in order, one connected trace
+        assert stages[0] == "enqueue" and stages[-1] == "resolve"
+        for required in ("admit", "prefill", "slot", "finish"):
+            assert required in stages, stages
+        assert stages.index("prefill") < stages.index("slot")
+        pre = next(e for e in events if e["event"] == "prefill")
+        assert pre["attrs"]["bucket"] >= 1
+        assert len({e["trace_id"] for e in events}) == 1
 
 
 # -- acceptance: >= 32 concurrent requests against a real tiny model -------

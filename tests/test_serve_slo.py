@@ -117,6 +117,86 @@ class SimEngine:
         self._active[idx] = False
 
 
+class _Prefilled:
+    """The DisaggSimEngine's prefill handle (the PrefilledArticle
+    analogue): steps remaining + the bucket the encoder pass ran at +
+    the article's true length for the length-masked chunk cost."""
+
+    def __init__(self, example, steps, bucket, words):
+        self.example = example
+        self.steps = steps
+        self.bucket = bucket
+        self.words = words
+
+
+class DisaggSimEngine(SimEngine):
+    """The DISAGGREGATED cost model (ISSUE 11) over the same virtual
+    clock, driven through the REAL ContinuousBatcher prefill queue:
+
+      * ``prefill(example)`` — the bucketed encoder stage — costs
+        bucket(words) * prefill_ms_per_word (encoder work scales with
+        the article's bucket, the BYTE_BUDGET.json decode.prefill
+        claim);
+      * each chunk costs chunk * step_cost * max(floor,
+        longest_active_words / long_words) — the length-masked decode
+        (per-chunk work follows the longest ACTIVE resident's true
+        length, the decode.length_axis claim; `floor` models the
+        length-independent share of the step: vocab projection, beam
+        bookkeeping).
+    """
+
+    def __init__(self, wl):
+        super().__init__(wl)
+        self._words = [0] * self.slots
+
+    def _bucket(self, words):
+        for b in self._wl["buckets"]:
+            if words <= b:
+                return b
+        return self._wl["buckets"][-1]
+
+    def prefill(self, example):
+        bucket = self._bucket(example.enc_len)
+        self.vtime += bucket * self._wl["prefill_ms_per_word"]
+        return _Prefilled(example, _steps_for(example, self._wl), bucket,
+                          example.enc_len)
+
+    def pack(self, idx, pre):
+        assert not self._active[idx]
+        self._active[idx] = True
+        self._remaining[idx] = pre.steps
+        self._words[idx] = pre.words
+
+    def step(self):
+        longest = max((self._words[i] for i in range(self.slots)
+                       if self._active[i]), default=0)
+        frac = max(self._wl["decode_len_floor"],
+                   longest / self._wl["long_words"])
+        self.vtime += self.chunk * self._cost * frac
+        fin = []
+        for i in range(self.slots):
+            if self._active[i]:
+                self._remaining[i] -= self.chunk
+                if self._remaining[i] <= 0:
+                    fin.append(i)
+        return fin
+
+
+class UniformSimEngine(SimEngine):
+    """The PRE-CHANGE one-resident-shape cost model: every admission
+    pays the FULL-width encoder (pack cost = long_words *
+    prefill_ms_per_word regardless of article length — what
+    pack_slot_jit did before the prefill stage existed) and every chunk
+    costs full width (no length mask).  No ``prefill`` surface, so the
+    ContinuousBatcher runs its legacy direct-pack path — the baseline
+    the disaggregated section's ratios are committed against."""
+
+    def pack(self, idx, example):
+        self.vtime += self._wl["long_words"] * \
+            self._wl["prefill_ms_per_word"]
+        super().pack(idx, example)
+
+
 class SimDecoder:
     """decode_batch over the same virtual cost model: one dispatch costs
     max(d_i) * step_cost — every member of the batch, short or long,
@@ -257,3 +337,104 @@ def test_continuous_beats_microbatch_occupancy(slo, measured):
         f"continuous occupancy / micro-batch utilization = {adv:.2f} "
         f"(committed min {adv_min:.2f}) — slot recycling no longer "
         f"recovers the straggler waste")
+
+
+# -- prefill/decode disaggregation (ISSUE 11) ------------------------------
+#
+# Same virtual-time discipline, new claim: under the committed bimodal
+# mix, DISAGGREGATION (bucketed prefill + length-masked chunks, the
+# DisaggSimEngine cost model, driven through the REAL ContinuousBatcher
+# prefill queue) beats the pre-change one-resident-shape cost model
+# (UniformSimEngine) on SHORT-request p50 while long-request-dominated
+# p99 stays pinned — short articles stop paying long articles' shapes,
+# and nobody pays more.
+
+
+def _run_disagg(slo, engine_cls):
+    wl = dict(slo["workload"])
+    wl.update(slo["disaggregated"]["workload"])
+    vocab = Vocab(words=WORDS)
+    hps = HParams(
+        mode="decode", batch_size=wl["batch_size"], vocab_size=vocab.size(),
+        max_enc_steps=wl["long_words"], max_dec_steps=wl["long_steps"],
+        beam_size=2, min_dec_steps=1, max_oov_buckets=4,
+        serve_max_queue=max(4 * wl["requests"], 64),
+        serve_mode="continuous", serve_slots=wl["slots"],
+        serve_refill_chunk=wl["chunk"],
+        serve_prefill_depth=wl["prefill_depth"])
+    arts = _articles(wl)
+    short = {f"u{i}" for i, a in enumerate(arts)
+             if len(a.split()) <= wl["short_words"]}
+    with obs.use_registry(Registry()) as reg:
+        sim = engine_cls(wl)
+        server = ServingServer(hps, vocab, decoder=_NullDecoder(),
+                               engine=sim, registry=reg)
+        futs = [server.submit(a, uuid=f"u{i}") for i, a in enumerate(arts)]
+        server.start()
+        results = [f.result(timeout=120) for f in futs]
+        server.stop()
+    assert [r.uuid for r in results] == \
+        [f"u{i}" for i in range(wl["requests"])]
+    assert set(sim.vresolve) == {f"u{i}" for i in range(wl["requests"])}
+    return sim.vresolve, short, reg
+
+
+@pytest.fixture(scope="module")
+def disagg_measured(slo):
+    dis_resolve, short, dis_reg = _run_disagg(slo, DisaggSimEngine)
+    uni_resolve, _, _ = _run_disagg(slo, UniformSimEngine)
+
+    def p50(resolve, keys):
+        xs = sorted(resolve[k] for k in keys)
+        return xs[len(xs) // 2]
+
+    return {
+        "dis_short_p50": p50(dis_resolve, short),
+        "uni_short_p50": p50(uni_resolve, short),
+        "dis_p99": _p99(dis_resolve.values()),
+        "uni_p99": _p99(uni_resolve.values()),
+        "prefills": dis_reg.counter("serve/prefill_total").value,
+        "prefill_bucket_mean":
+            dis_reg.histogram("serve/prefill_bucket_len").mean,
+        "requests": len(dis_resolve),
+    }
+
+
+def test_disagg_short_p50_beats_uniform_baseline(slo, disagg_measured):
+    ceiling = slo["disaggregated"]["short_p50_ratio_vs_uniform_max"]
+    ratio = disagg_measured["dis_short_p50"] \
+        / disagg_measured["uni_short_p50"]
+    assert ratio <= ceiling, (
+        f"disaggregated short-request p50 / uniform-padding baseline = "
+        f"{ratio:.2f} (committed max {ceiling:.2f}) on the bimodal mix — "
+        f"short articles are paying long articles' shapes again "
+        f"(see SERVE_SLO.json disaggregated._comment)")
+    abs_ceiling = slo["disaggregated"]["short_p50_virtual_ms_max"]
+    assert disagg_measured["dis_short_p50"] <= abs_ceiling, (
+        f"disaggregated short-request p50 rose to "
+        f"{disagg_measured['dis_short_p50']:.0f} virtual ms (committed "
+        f"ceiling {abs_ceiling:.0f})")
+
+
+def test_disagg_p99_stays_pinned(slo, disagg_measured):
+    """The 'at fixed p99' half of the claim: the tail (long-request
+    dominated) must not regress past the committed ratio — prefill
+    serialization on the dispatch thread cannot be bought with tail
+    latency."""
+    ceiling = slo["disaggregated"]["p99_ratio_vs_uniform_max"]
+    ratio = disagg_measured["dis_p99"] / disagg_measured["uni_p99"]
+    assert ratio <= ceiling, (
+        f"disaggregated p99 / uniform baseline p99 = {ratio:.2f} "
+        f"(committed max {ceiling:.2f}) — the disaggregated path "
+        f"regressed the tail")
+
+
+def test_disagg_runs_through_the_real_prefill_queue(slo, disagg_measured):
+    """The gate drives the REAL ContinuousBatcher: every request went
+    through the prefill stage exactly once, and the mean prefill bucket
+    sits strictly below the top bucket (short articles really routed to
+    short encoder shapes)."""
+    wl = dict(slo["workload"])
+    wl.update(slo["disaggregated"]["workload"])
+    assert disagg_measured["prefills"] == disagg_measured["requests"]
+    assert disagg_measured["prefill_bucket_mean"] < wl["long_words"]

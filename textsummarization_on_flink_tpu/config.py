@@ -236,6 +236,24 @@ class HParams:
     # pinned by test.  The pointer-generator family has no KV cache and
     # ignores this flag.
     decode_cache_dtype: str = "float32"
+    # ---- prefill/decode disaggregation (SERVING.md; ISSUE 11) ----
+    # Encoder-key block length for the LENGTH-MASKED slot decode step:
+    # cross-attention (and the pg attends) over a resident's encoder
+    # state runs as a chain of this-many-position blocks, each gated by
+    # a TRACED "block < ceil(max_active_valid_len / block)" predicate —
+    # so per-chunk decode FLOPs/bytes scale with the longest ACTIVE
+    # resident's true article length (block-granular) instead of the
+    # uniform max_enc_steps padding, while the step kernel still
+    # compiles exactly once.  Clamped to max_enc_steps; 64 keeps the
+    # per-block matmuls MXU-shaped at reference scale (400 -> 7 blocks).
+    decode_enc_block: int = 64
+    # Continuous-mode prefill lookahead: how many requests beyond the
+    # currently-free slots the ContinuousBatcher prefills per tick
+    # (encoder + cross-attention cache at the article's bucket shape),
+    # so a slot freed at the next chunk boundary refills from an
+    # already-encoded article instead of paying prefill latency inline.
+    # 0 = prefill exactly the free slots.
+    serve_prefill_depth: int = 2
     # ---- speculative decode tier (SERVING.md "Quality tiers"; ISSUE 10) ----
     # Draft tokens proposed per verify cycle: the draft model (AAN
     # family) proposes spec_k tokens greedily, the full model scores all
@@ -492,6 +510,13 @@ class HParams:
             raise ValueError(
                 f"serve_refill_chunk must be >= 0 (0 = TS_BEAM_CHUNK "
                 f"default), got {self.serve_refill_chunk}")
+        if self.decode_enc_block < 1:
+            raise ValueError(
+                f"decode_enc_block must be >= 1, got {self.decode_enc_block}")
+        if self.serve_prefill_depth < 0:
+            raise ValueError(
+                f"serve_prefill_depth must be >= 0, got "
+                f"{self.serve_prefill_depth}")
         if self.faults:
             # parse for validation only (unknown points / bad probs fail
             # here, at config time, not at the injection site)
@@ -582,6 +607,27 @@ def resolve_serve_slots(hps: "HParams") -> int:
     when 0) — the ONE resolver, shared by serve/server.py and bench.py
     so a measurement's slot count is exactly the server's."""
     return hps.serve_slots or hps.batch_size
+
+
+def resolve_enc_block(hps: "HParams") -> int:
+    """Effective encoder-key block length for the length-masked slot
+    decode step (prefill/decode disaggregation, SERVING.md): the
+    decode_enc_block HParam clamped to [1, max_enc_steps] — the ONE
+    resolver, shared by the model families' blocked attention paths and
+    __graft_entry__.decode_step_cost, so the measured program's block
+    structure is exactly the served one's."""
+    return max(1, min(int(hps.decode_enc_block), hps.max_enc_steps))
+
+
+def bucket_for(buckets: "List[int]", enc_len: int) -> int:
+    """Smallest bucket covering ``enc_len`` (the serve/ micro-batcher's
+    routing rule, now shared with the continuous engine's prefill stage
+    — ONE rule, so the two serving modes bucket identically).  Articles
+    are already truncated to buckets[-1] by SummaryExample.build."""
+    for b in buckets:
+        if enc_len <= b:
+            return b
+    return buckets[-1]
 
 
 def resolve_refill_chunk(hps: "HParams") -> int:

@@ -456,6 +456,7 @@ def run_beam_search_jit(params, hps: HParams, arrays: Dict[str, Array],
 
 # --------------------------------------------------------------------------
 # Slot-state search: the continuous-batching kernel set (ISSUE 6)
+# + prefill/decode disaggregation (ISSUE 11)
 # --------------------------------------------------------------------------
 #
 # The batch search above is all-or-nothing: one dispatch decodes B
@@ -463,17 +464,38 @@ def run_beam_search_jit(params, hps: HParams, arrays: Dict[str, Array],
 # FastSeq (PAPERS.md) removes.  The slot API splits that dispatch into
 # chunk-granular pieces over a persistent [slots, beam, ...] state so a
 # host scheduler (serve/batcher.ContinuousBatcher) can retire finished
-# articles and refill their slots between chunks:
+# articles and refill their slots between chunks.
 #
-#     state = init_slots_jit(params, hps, zero_arrays)     # once
-#     state = pack_slot_jit(params, hps, state, i, arrays1) # admit
+# The request lifecycle is DISAGGREGATED into two stages (ISSUE 11):
+#
+#   PREFILL — encoder + cross-attention cache build, at the article's
+#   micro-batcher bucket shape (config.parse_bucket_spec): one
+#   prefill_jit compile per bucket, cost scaling with the bucket, never
+#   with max_enc_steps.  The output is padded to the ONE resident width
+#   and stamped with the article's true valid length.
+#
+#   DECODE — the persistent slot loop at one resident shape, carrying a
+#   per-resident ``enc_valid_len``: each chunk's cross-attention runs a
+#   conditional chain of encoder-key blocks bounded by the longest
+#   ACTIVE resident's true length (see the family beam_adapter_masked
+#   docs), so per-chunk bytes/FLOPs scale with real article lengths
+#   instead of uniform padding — the FastSeq rule ("never let one
+#   sequence's shape dictate the batch's cost") applied to the resident
+#   set, at block granularity.
+#
+#     pre   = prefill_jit(params, hps, bucket_arrays)       # per admit
+#     state = init_slots_jit(params, hps, zero_arrays)      # once
+#     state = pack_slot_jit(params, hps, state, i, pre)     # admit
 #     state, finished = step_slots_jit(params, hps, state, active, chunk)
 #     out = unpack_slot_jit(hps, state, i)                  # retire
 #
 # Contracts:
-#   * every kernel is shape-stable — slot index and active mask are
-#     TRACED arguments, so after the four warmup compiles NO request,
-#     slot choice, or occupancy pattern triggers a recompile;
+#   * every DECODE kernel is shape-stable — slot index, active mask,
+#     and valid lengths are TRACED arguments, so after the four warmup
+#     compiles NO request, slot choice, occupancy pattern, or article
+#     LENGTH pattern triggers a recompile; prefill_jit adds exactly one
+#     compile per serve bucket (the warm set is 4 + len(buckets),
+#     pinned by test);
 #   * per-slot activity masks: an inactive slot's ORDER-SENSITIVE state
 #     (_SELECT_FIELDS: step counter, live beam, result pool) is carried
 #     through step_slots_jit unchanged — the same masked-update select
@@ -501,15 +523,33 @@ class SlotState(NamedTuple):
     beam leaves lead with [slots, ...] (each slot an independent
     _BeamState); enc_view is the family's per-article encoder pytree
     stacked over slots; enc_mask/ext_ids are [slots, T_enc].  All
-    shapes static: T_enc is fixed for the state's lifetime (continuous
-    serving pads every article to one length instead of bucketing —
-    one resident shape is what makes slot recycling shape-stable).
+    shapes static: T_enc is fixed for the state's lifetime (one
+    resident shape is what makes slot recycling shape-stable) — but a
+    resident's COST is not: ``enc_valid_len`` carries each article's
+    true length, the prefill stage fills only the valid prefix (zeros
+    past it), and step_slots_jit bounds the cross-attention block chain
+    by the longest active valid length (ISSUE 11).
     """
 
     beam: Any  # _BeamState with [slots, ...] leaves
     enc_view: Any  # family encoder view, [slots, ...] leaves
     enc_mask: Array  # [slots, T_enc]
     ext_ids: Array  # [slots, T_enc]
+    enc_valid_len: Array  # [slots] int32 true (pre-padding) article length
+
+
+class PrefillState(NamedTuple):
+    """One prefilled article (leading axis 1), ready for pack_slot_jit:
+    the encoder + cross-attention cache built at the article's BUCKET
+    shape by prefill_jit, zero-padded out to the resident width, plus
+    the true valid length the decode stage masks by.  The zero tail is
+    semantically dead (behind the valid-length mask) — padding here is
+    what keeps pack_slot_jit at ONE compile across buckets."""
+
+    enc_view: Any  # family encoder view, [1, T_enc_max, ...] leaves
+    enc_mask: Array  # [1, T_enc_max]
+    ext_ids: Array  # [1, T_enc_max]
+    enc_valid_len: Array  # [1] int32
 
 
 def _init_slot_beams(params, hps: HParams, enc_view, enc_mask):
@@ -532,34 +572,72 @@ def init_slots_jit(params, hps: HParams,
     fully overwritten by pack_slot_jit before first use)."""
     family = get_family(hps.model_family)
     enc_view = family.beam_encode(params, hps, arrays)
+    slots = arrays["enc_padding_mask"].shape[0]
     return SlotState(
         beam=_init_slot_beams(params, hps, enc_view,
                               arrays["enc_padding_mask"]),
         enc_view=enc_view,
         enc_mask=arrays["enc_padding_mask"],
-        ext_ids=arrays["enc_batch_extend_vocab"])
+        ext_ids=arrays["enc_batch_extend_vocab"],
+        enc_valid_len=jnp.zeros((slots,), jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("hps",))
+def prefill_jit(params, hps: HParams,
+                arrays: Dict[str, Array]) -> PrefillState:
+    """The PREFILL stage (ISSUE 11): encoder + cross-attention cache for
+    ONE article at its BUCKET shape — ``arrays`` leaves are [1, bucket]
+    — then zero-padded to the resident width (hps.max_enc_steps) so
+    pack_slot_jit stays at one compile.  The jit cache keys on the
+    input shapes, so the warm set is exactly one executable per serve
+    bucket; the encoder work (the LSTM scan / the T_enc^2 encoder
+    self-attention — the cost the one-resident-shape engine used to pay
+    at FULL width for every admission) scales with the bucket.
+
+    Both families' encoders are pad-invariant (masked LSTM
+    carry-through / masked softmax), so the valid prefix of the bucket
+    encode is bitwise the valid prefix of a full-width encode — parity
+    with the batch search is by construction, not by tolerance."""
+    family = get_family(hps.model_family)
+    enc_view = family.pad_enc_view(family.beam_encode(params, hps, arrays),
+                                   hps.max_enc_steps)
+    T = hps.max_enc_steps
+
+    def pad_t(x):
+        if x.shape[1] >= T:
+            return x
+        return jnp.pad(x, [(0, 0), (0, T - x.shape[1])])
+
+    return PrefillState(
+        enc_view=enc_view,
+        enc_mask=pad_t(arrays["enc_padding_mask"]),
+        ext_ids=pad_t(arrays["enc_batch_extend_vocab"]),
+        enc_valid_len=arrays["enc_lens"].astype(jnp.int32))
 
 
 @functools.partial(jax.jit, static_argnames=("hps",))
 def pack_slot_jit(params, hps: HParams, state: SlotState, idx,
-                  arrays: Dict[str, Array]) -> SlotState:
-    """Admit ONE article (leading axis 1) into slot `idx`: encode it,
-    initialize its search, and scatter both into the persistent state.
-    `idx` is traced — one compile serves every slot."""
-    family = get_family(hps.model_family)
-    enc_view1 = family.beam_encode(params, hps, arrays)
-    beam1 = _init_slot_beams(params, hps, enc_view1,
-                             arrays["enc_padding_mask"])
+                  pre: PrefillState) -> SlotState:
+    """Admit ONE PREFILLED article into slot `idx` — the
+    pack-with-length-mask (ISSUE 11): scatter the padded encoder view,
+    initialize the slot's search, and stamp the resident's true valid
+    length (what the decode stage's block chain and attention masks key
+    on).  `idx` is traced — one compile serves every slot, and because
+    prefill already normalized every bucket to the resident width, one
+    compile serves every bucket too."""
+    beam1 = _init_slot_beams(params, hps, pre.enc_view, pre.enc_mask)
 
     def write(dst, src):
         return dst.at[idx].set(src[0])
 
     return SlotState(
         beam=jax.tree_util.tree_map(write, state.beam, beam1),
-        enc_view=jax.tree_util.tree_map(write, state.enc_view, enc_view1),
-        enc_mask=state.enc_mask.at[idx].set(arrays["enc_padding_mask"][0]),
-        ext_ids=state.ext_ids.at[idx].set(
-            arrays["enc_batch_extend_vocab"][0]))
+        enc_view=jax.tree_util.tree_map(write, state.enc_view,
+                                        pre.enc_view),
+        enc_mask=state.enc_mask.at[idx].set(pre.enc_mask[0]),
+        ext_ids=state.ext_ids.at[idx].set(pre.ext_ids[0]),
+        enc_valid_len=state.enc_valid_len.at[idx].set(
+            pre.enc_valid_len[0]))
 
 
 @functools.partial(jax.jit, static_argnames=("hps", "chunk"))
@@ -576,13 +654,32 @@ def step_slots_jit(params, hps: HParams, state: SlotState, active,
     into the selected leaves — while the dead lane's history columns
     and dec_state DO take garbage writes, all confined to regions
     unpack_slot_jit never reads and fully overwritten by the next
-    pack_slot_jit (see the slot-contract comment above)."""
+    pack_slot_jit (see the slot-contract comment above).
+
+    Length-masked decode (ISSUE 11): the chunk's cross-attention block
+    chain is bounded by ``nb`` = ceil(max active enc_valid_len /
+    resolve_enc_block) — a TRACED scalar, uniform across the vmapped
+    slots, so the conditional chain survives the vmap as real branches
+    and one compile serves every length pattern.  Work executed per
+    chunk scales with the longest ACTIVE resident's true article
+    length; shorter co-residents' extra blocks are masked to the same
+    energy floor the dense path gives padding, so trajectories stay
+    token-exact with the batch search."""
     family = get_family(hps.model_family)
-    _, step_fn = family.beam_adapter(hps)
+    _, step_fn = family.beam_adapter_masked(hps)
     cond = _beam_cond(hps)
+    from textsummarization_on_flink_tpu.config import resolve_enc_block
+
+    block = resolve_enc_block(hps)
+    valid = jnp.where(active, state.enc_valid_len,
+                      jnp.zeros_like(state.enc_valid_len))
+    nb = (jnp.max(valid) + block - 1) // block  # scalar, traced
 
     def one(beam, act, enc_one, mask, ext):
-        body = _make_beam_body(params, hps, step_fn, enc_one, mask, ext)
+        def step_nb(p, e, m, x, t, latest, s):
+            return step_fn(p, e, m, x, nb, t, latest, s)
+
+        body = _make_beam_body(params, hps, step_nb, enc_one, mask, ext)
 
         def masked_cond(s):
             return jnp.logical_and(act, cond(s))

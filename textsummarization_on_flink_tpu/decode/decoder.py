@@ -28,7 +28,7 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -37,7 +37,9 @@ from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
 from textsummarization_on_flink_tpu.config import (
     SERVE_TIERS,
     HParams,
+    bucket_for,
     derive_draft_hps,
+    parse_bucket_spec,
 )
 from textsummarization_on_flink_tpu.data import oov as oov_lib
 from textsummarization_on_flink_tpu.data.batching import Batch
@@ -579,20 +581,37 @@ class BeamSearchDecoder:
         log.info("Wrote visualization data to %s", output_fname)
 
 
+class PrefilledArticle(NamedTuple):
+    """Host-side handle for one article through the PREFILL stage
+    (ISSUE 11): the device-resident PrefillState (encoder +
+    cross-attention cache at the article's bucket, padded to the
+    resident width) plus the request bookkeeping pack needs."""
+
+    example: Any  # the SummaryExample (uuid/reference/OOVs travel here)
+    state: Any  # beam_search.PrefillState
+    bucket: int  # the encoder bucket the prefill compiled/ran at
+
+
 class SlotDecodeEngine:
-    """Host driver of beam_search's persistent slot kernels (ISSUE 6).
+    """Host driver of beam_search's persistent slot kernels (ISSUE 6),
+    disaggregated into a bucketed prefill stage and a length-masked
+    decode stage (ISSUE 11).
 
     Owns the [slots, beam, ...] resident state and the per-slot activity
     mask; the scheduler above it (serve/batcher.ContinuousBatcher) owns
     request bookkeeping.  Single-threaded by design — the one
-    continuous-dispatch thread calls pack/step/unpack; the ONLY chunk
-    boundary host sync is reading the `finished` mask in step().
+    continuous-dispatch thread calls prefill/pack/step/unpack; the ONLY
+    chunk boundary host sync is reading the `finished` mask in step().
 
-    Shape discipline: every article is padded to ``hps.max_enc_steps``
-    (continuous mode trades the micro-batcher's length buckets for ONE
-    resident shape — that is what makes slot recycling shape-stable),
-    so the whole engine warms exactly four compiles (init/pack/step/
-    unpack); slot index and occupancy are traced arguments.  Compile
+    Shape discipline: the RESIDENT state keeps one shape
+    (``hps.max_enc_steps`` wide — that is what makes slot recycling
+    shape-stable), so the decode kernels warm exactly four compiles
+    (init/pack/step/unpack) with slot index, occupancy, and valid
+    lengths all traced.  The COST no longer follows the shape: prefill
+    runs the encoder at the article's micro-batcher bucket
+    (``serve_buckets`` — one prefill compile per bucket), and each
+    decode chunk's cross-attention is bounded by the longest active
+    resident's true length (beam_search.step_slots_jit).  Compile
     activity stays visible in the existing
     ``decode/compile_cache_*_total`` counters.
 
@@ -622,6 +641,11 @@ class SlotDecodeEngine:
         self.chunk = min(chunk, self._hps.max_dec_steps)
         self._t_enc = self._hps.max_enc_steps
         self._hps1 = self._hps.replace(batch_size=1)
+        # prefill stage buckets — the micro-batcher's exact list (ONE
+        # parser, config.parse_bucket_spec), so the two serving modes
+        # route articles to identical encoder shapes
+        self._buckets = parse_bucket_spec(self._hps.serve_buckets,
+                                          self._hps.max_enc_steps)
         self._state = None  # lazy: first pack pays the init compile
         self._active = np.zeros(slots, dtype=bool)
         self._obs = obs.registry_for(self._hps)
@@ -704,19 +728,41 @@ class SlotDecodeEngine:
             self._jitted(beam_search.init_slots_jit, params,
                          self._hps, zero))
 
-    def pack(self, idx: int, example) -> None:
-        """Admit one SummaryExample into slot `idx` (must be free)."""
-        if self._active[idx]:
-            raise AssertionError(f"slot {idx} is already resident")
+    def prefill(self, example) -> PrefilledArticle:
+        """The PREFILL stage for one SummaryExample (ISSUE 11): encoder
+        + cross-attention cache at the article's bucket shape — one
+        prefill_jit compile per bucket, cost scaling with the bucket —
+        returning the padded, valid-length-stamped handle pack()
+        scatters into a slot.  Safe to run while other articles are
+        resident (the scheduler overlaps prefill with decode ticks)."""
         params = self._params()
-        self._ensure_state(params)
+        bucket = bucket_for(self._buckets, example.enc_len)
         batch = Batch([example], self._hps1, self._dec._vocab,
-                      enc_steps=self._t_enc)
+                      enc_steps=bucket)
         arrays = {k: v for k, v in batch.as_arrays().items()
                   if k.startswith("enc_")}
+        pre = self._jitted(beam_search.prefill_jit, params, self._hps,
+                           arrays)
+        if self._registry is not None:
+            import jax
+
+            reg = self._registry
+            pre = jax.device_put(
+                pre, reg.shardings(reg.prefill_state_specs(pre)))
+        return PrefilledArticle(example=example, state=pre, bucket=bucket)
+
+    def pack(self, idx: int, item) -> None:
+        """Admit one prefilled article (or a raw SummaryExample, which
+        is prefilled inline) into slot `idx` (must be free)."""
+        if self._active[idx]:
+            raise AssertionError(f"slot {idx} is already resident")
+        if not isinstance(item, PrefilledArticle):
+            item = self.prefill(item)
+        params = self._params()
+        self._ensure_state(params)
         self._state = self._pin_state(
             self._jitted(beam_search.pack_slot_jit, params,
-                         self._hps, self._state, idx, arrays))
+                         self._hps, self._state, idx, item.state))
         self._active[idx] = True
 
     def step(self) -> List[int]:
@@ -769,12 +815,14 @@ class SlotDecodeEngine:
         return int(self._active.sum())
 
     def cache_sizes(self) -> Dict[str, int]:
-        """Jit-cache entry counts of the four slot kernels — the
-        'bounded compile cache' evidence (tests assert no growth after
-        warmup)."""
+        """Jit-cache entry counts of the four decode kernels plus the
+        bucketed prefill — the 'bounded compile cache' evidence (tests
+        assert the decode kernels never grow after warmup and prefill
+        stays at one entry per serve bucket)."""
         out: Dict[str, int] = {}
-        for fn in (beam_search.init_slots_jit, beam_search.pack_slot_jit,
-                   beam_search.step_slots_jit, beam_search.unpack_slot_jit):
+        for fn in (beam_search.init_slots_jit, beam_search.prefill_jit,
+                   beam_search.pack_slot_jit, beam_search.step_slots_jit,
+                   beam_search.unpack_slot_jit):
             try:
                 out[fn.__wrapped__.__name__] = fn._cache_size()
             except Exception:  # tslint: disable=TS005 — private jax API; absent on some builds

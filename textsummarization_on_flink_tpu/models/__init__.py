@@ -21,6 +21,28 @@ from types import ModuleType
 FAMILIES = ("pointer_generator", "transformer", "avg_attention")
 
 
+def masked_adapter(beam_adapter_fn):
+    """Derive a family's ``beam_adapter_masked`` from its
+    ``beam_adapter`` (the length-masked slot-decode protocol, ISSUE 11):
+    the same step with an explicit leading ``nb`` (traced active-block
+    count) argument, which step_slots_jit binds from the residents'
+    valid lengths.  ONE wrapper — the calling convention lives here, so
+    a future change to the masked-step signature lands in one place for
+    every family."""
+
+    def beam_adapter_masked(hps):
+        init_state, step = beam_adapter_fn(hps)
+
+        def step_masked(params, enc_one, enc_mask, ext_ids, nb, t, latest,
+                        state):
+            return step(params, enc_one, enc_mask, ext_ids, t, latest,
+                        state, nb=nb)
+
+        return init_state, step_masked
+
+    return beam_adapter_masked
+
+
 def get_family(name: str) -> ModuleType:
     """Resolve a model-family name to its module (lazy imports keep
     startup light and avoid cycles)."""

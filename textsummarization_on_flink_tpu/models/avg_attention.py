@@ -48,6 +48,7 @@ import jax.numpy as jnp
 
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.models import pointer_generator as pg
+from textsummarization_on_flink_tpu import models as models_lib
 from textsummarization_on_flink_tpu.models import transformer as tf
 
 Array = jax.Array
@@ -288,8 +289,8 @@ def beam_encode(params: Params, hps: HParams, arrays: Dict[str, Array],
 def decode_onestep(params: Params, hps: HParams,
                    enc_one: TransformerEncView, enc_mask: Array,
                    ext_ids: Array, t: Array, latest: Array,
-                   aan_sum: Array) -> Tuple[Array, Array, Array, Array,
-                                            Array]:
+                   aan_sum: Array, nb=None) -> Tuple[Array, Array, Array,
+                                                     Array, Array]:
     """One AAN decode step for K hypotheses: O(1) in history — the only
     carried decode state is the [K, L, H] running sum (f32), updated by
     one add; no cache gather, no attention over past positions.
@@ -313,7 +314,7 @@ def decode_onestep(params: Params, hps: HParams,
         # decode paths (beam step / spec verify / this)
         cross_out, attn_dist = tf.cross_attend_layer(
             hps, layer, y, enc_one.cross_k[li], enc_one.cross_v[li],
-            enc_mask)
+            enc_mask, nb=nb)
         y = y + cross_out
         y = y + tf._ffn_block(layer["ffn"], tf._ln(layer["ln2"], y))
         cross_ctx = cross_out
@@ -338,10 +339,11 @@ def beam_adapter(hps: HParams):
         return {"aan_sum": jnp.zeros((K, L, H), jnp.float32)}
 
     def step(params: Params, enc_one: TransformerEncView, enc_mask: Array,
-             ext_ids: Array, t: Array, latest: Array, state) -> BeamStepOut:
+             ext_ids: Array, t: Array, latest: Array, state,
+             nb=None) -> BeamStepOut:
         final_dist, attn_dist, p_gen, _, new_sum = decode_onestep(
             params, hps, enc_one, enc_mask, ext_ids, t, latest,
-            state["aan_sum"])
+            state["aan_sum"], nb=nb)
         topk_probs, topk_ids = jax.lax.top_k(final_dist, 2 * hps.beam_size)
         return BeamStepOut(topk_ids=topk_ids,
                            topk_log_probs=jnp.log(topk_probs + 1e-10),
@@ -349,3 +351,13 @@ def beam_adapter(hps: HParams):
                            state={"aan_sum": new_sum})
 
     return init_state, step
+
+
+#: the length-masked slot-decode adapter (ISSUE 11) — the shared
+#: protocol wrapper; nb reaches the transformer cross-attention block
+beam_adapter_masked = models_lib.masked_adapter(beam_adapter)
+
+
+#: the AAN encoder view IS the transformer's (same K/V precompute), so
+#: the prefill pad hand-off is the transformer's too
+pad_enc_view = tf.pad_enc_view

@@ -25,11 +25,13 @@ All public functions are pure and jittable; `hps` is static.
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from textsummarization_on_flink_tpu import config as config_lib
+from textsummarization_on_flink_tpu import models as models_lib
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.ops import attention as attn_ops
 from textsummarization_on_flink_tpu.ops import losses as loss_ops
@@ -387,7 +389,8 @@ def decode_onestep(params: Params, hps: HParams, enc: EncoderOutput,
 def decode_onestep_shared(params: Params, hps: HParams, enc_one: EncoderOutput,
                           enc_mask: Array, ext_ids: Array,
                           latest_tokens: Array, state: Tuple[Array, Array],
-                          prev_coverage: Array) -> DecodeStepOutput:
+                          prev_coverage: Array,
+                          nb: Optional[Array] = None) -> DecodeStepOutput:
     """decode_onestep with the PER-ARTICLE encoder view shared across
     the K beam hypotheses (decode byte diet, ISSUE 7): enc_one leaves
     are [T_enc, ...] with no hypothesis axis, enc_mask/ext_ids [T_enc].
@@ -396,12 +399,19 @@ def decode_onestep_shared(params: Params, hps: HParams, enc_one: EncoderOutput,
     `jnp.broadcast_to` the adapter used to materialize per step; only
     genuinely per-hypothesis tensors (cell state, coverage, the
     extended-vocab mixture) carry K.  Same decode-mode semantics
-    (initial_state_attention=True) step for step."""
+    (initial_state_attention=True) step for step.
+
+    ``nb`` (length-masked slot decode, ISSUE 11): traced active-block
+    count routing both attends through the blocked conditional chain
+    (ops/attention._attend_shared_blocked) so per-step encoder traffic
+    scales with the longest active resident's true length."""
     dp = params["decoder"]
     use_cov = hps.coverage
+    block = config_lib.resolve_enc_block(hps) if nb is not None else 0
     ctx_prev, _, cov = attn_ops.attend_shared(
         dp["attention"], enc_one.enc_states, enc_one.enc_features, enc_mask,
-        state, prev_coverage if use_cov else None, use_cov)
+        state, prev_coverage if use_cov else None, use_cov,
+        nb=nb, block=block)
     if cov is None:
         cov = prev_coverage
     inp_emb = params["embedding"][latest_tokens]
@@ -409,7 +419,8 @@ def decode_onestep_shared(params: Params, hps: HParams, enc_one: EncoderOutput,
     cell_out, new_state = lstm_ops.lstm_cell(dp["cell"], x, state)
     context, attn_dist, _ = attn_ops.attend_shared(
         dp["attention"], enc_one.enc_states, enc_one.enc_features, enc_mask,
-        new_state, cov if use_cov else None, use_cov)
+        new_state, cov if use_cov else None, use_cov,
+        nb=nb, block=block)
     p_gen = jax.nn.sigmoid(
         _linear(dp["pgen_linear"], context, new_state[0], new_state[1], x))[:, 0]
     output = _linear(dp["output_linear"], cell_out, context)
@@ -471,14 +482,15 @@ def beam_adapter(hps: HParams):
         }
 
     def step(params: Params, enc_one: EncoderOutput, enc_mask: Array,
-             ext_ids: Array, t: Array, latest: Array, state) -> BeamStepOut:
+             ext_ids: Array, t: Array, latest: Array, state,
+             nb=None) -> BeamStepOut:
         del t  # the LSTM state carries all positional context
         # per-article encoder view handed through UN-broadcast (decode
         # byte diet): only cell state + coverage carry the K axis
         out = decode_onestep_shared(params, hps, enc_one, enc_mask, ext_ids,
                                     latest,
                                     (state["cell_c"], state["cell_h"]),
-                                    state["coverage"])
+                                    state["coverage"], nb=nb)
         return BeamStepOut(
             topk_ids=out.topk_ids, topk_log_probs=out.topk_log_probs,
             attn_dist=out.attn_dist, p_gen=out.p_gen,
@@ -486,3 +498,29 @@ def beam_adapter(hps: HParams):
                    "coverage": out.coverage})
 
     return init_state, step
+
+
+#: the length-masked slot-decode adapter (ISSUE 11): the shared
+#: protocol wrapper threads the traced block count into this family's
+#: step, where it scales the two encoder attends with true length
+beam_adapter_masked = models_lib.masked_adapter(beam_adapter)
+
+
+def pad_enc_view(enc_view: EncoderOutput, t_target: int) -> EncoderOutput:
+    """Zero-pad a bucket-width encoder view's time axis to ``t_target``
+    (the prefill -> pack hand-off, decode/beam_search.prefill_jit).
+    The biLSTM encoder is pad-invariant by construction (masked
+    carry-through + length-aware reverse, ops/lstm.py), so a
+    bucket-width encode equals the valid prefix of a full-width one and
+    zeros are exactly what full-width encoding writes past the valid
+    length; dec_in_state carries no time axis."""
+    def pad(x):
+        if x.shape[1] >= t_target:
+            return x
+        widths = [(0, 0)] * x.ndim
+        widths[1] = (0, t_target - x.shape[1])
+        return jnp.pad(x, widths)
+
+    return EncoderOutput(enc_states=pad(enc_view.enc_states),
+                         enc_features=pad(enc_view.enc_features),
+                         dec_in_state=enc_view.dec_in_state)

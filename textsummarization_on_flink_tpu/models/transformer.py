@@ -50,6 +50,8 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from textsummarization_on_flink_tpu import config as config_lib
+from textsummarization_on_flink_tpu import models as models_lib
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.models import pointer_generator as pg
 from textsummarization_on_flink_tpu.ops import losses as loss_ops
@@ -468,6 +470,7 @@ BeamStepOut = pg.BeamStepOut  # shared beam protocol output type
 
 def cross_attend_layer(hps: HParams, layer: Dict[str, Any], y: Array,
                        ck: Array, cv: Array, enc_mask: Array,
+                       nb: Optional[Array] = None,
                        ) -> Tuple[Array, Array]:
     """One decoder layer's cross-attention against its precomputed
     per-article K/V (``TransformerEncView`` slices) for a stack of R
@@ -477,18 +480,58 @@ def cross_attend_layer(hps: HParams, layer: Dict[str, Any], y: Array,
 
     y: [R, H]; ck/cv: [T_enc, nh, hd]; enc_mask: [T_enc].  Returns
     (cross_out [R, H] — NOT yet residual-added — and the head-averaged
-    probabilities [R, T_enc], f32)."""
+    probabilities [R, T_enc], f32).
+
+    ``nb`` (length-masked slot decode, ISSUE 11): traced active-block
+    count — the logits/context einsums run as a statically-unrolled
+    chain of ``resolve_enc_block(hps)``-position key blocks, each gated
+    by a real XLA conditional on ``b < nb``, so the K/V bytes streamed
+    per step scale with the longest active resident's TRUE article
+    length.  Uncovered blocks sit at the masked-logit floor (exactly
+    where enc_mask=0 keys sit in the dense path), so softmax weights
+    there are 0 and skipped context blocks contribute exactly nothing;
+    the result differs from dense only by block-wise partial-sum
+    association.  nb=None keeps the dense einsums."""
     hd = _head_dim(hps)
     dt = y.dtype
     cp = layer["cross_attn"]
     qc = _split_heads(hps, _ln(layer["ln_cross"], y) @ cp["wq"].astype(dt))
-    clogits = jnp.einsum("knd,tnd->knt", qc.astype(jnp.float32),
-                         ck.astype(jnp.float32)) * (hd ** -0.5)
-    clogits = jnp.where(enc_mask[None, None, :] > 0, clogits, -1e30)
+    q32 = qc.astype(jnp.float32)
+    if nb is None:
+        clogits = jnp.einsum("knd,tnd->knt", q32,
+                             ck.astype(jnp.float32)) * (hd ** -0.5)
+        clogits = jnp.where(enc_mask[None, None, :] > 0, clogits, -1e30)
+    else:
+        T = enc_mask.shape[0]
+        block = config_lib.resolve_enc_block(hps)
+        nblocks = -(-T // block)
+        clogits = jnp.full(q32.shape[:2] + (T,), -1e30, jnp.float32)
+        for b in range(nblocks):
+            lo, hi = b * block, min((b + 1) * block, T)
+
+            def write_block(cl, lo=lo, hi=hi):
+                lb = jnp.einsum("knd,tnd->knt", q32,
+                                ck[lo:hi].astype(jnp.float32)) * (hd ** -0.5)
+                lb = jnp.where(enc_mask[lo:hi][None, None, :] > 0, lb, -1e30)
+                return cl.at[:, :, lo:hi].set(lb)
+
+            clogits = jax.lax.cond(b < nb, write_block, lambda cl: cl,
+                                   clogits)
     cprobs = jax.nn.softmax(clogits, axis=-1)
     any_key = jnp.sum(enc_mask) > 0
     cprobs = jnp.where(any_key, cprobs, 0.0)
-    cctx = jnp.einsum("knt,tnd->knd", cprobs, cv.astype(jnp.float32))
+    if nb is None:
+        cctx = jnp.einsum("knt,tnd->knd", cprobs, cv.astype(jnp.float32))
+    else:
+        cctx = jnp.zeros(q32.shape, jnp.float32)
+        for b in range(nblocks):
+            lo, hi = b * block, min((b + 1) * block, T)
+
+            def add_block(cc, lo=lo, hi=hi):
+                return cc + jnp.einsum("knt,tnd->knd", cprobs[:, :, lo:hi],
+                                       cv[lo:hi].astype(jnp.float32))
+
+            cctx = jax.lax.cond(b < nb, add_block, lambda cc: cc, cctx)
     cross_out = _merge_heads(cctx).astype(dt) @ cp["wo"].astype(dt)
     return cross_out, jnp.mean(cprobs, axis=1)
 
@@ -545,8 +588,10 @@ def beam_adapter(hps: HParams):
         }
 
     def step(params: Params, enc_one: TransformerEncView, enc_mask: Array,
-             ext_ids: Array, t: Array, latest: Array, state):
-        """enc_one leaves are per-article (no batch axis); latest: [K]."""
+             ext_ids: Array, t: Array, latest: Array, state, nb=None):
+        """enc_one leaves are per-article (no batch axis); latest: [K].
+        nb: traced active-block count for the length-masked slot path
+        (None = dense cross-attention, the batch-search default)."""
         y = _embed_dec(params, hps, latest, t)  # [K, H]
         pos_ok = (jnp.arange(T) <= t).astype(jnp.float32)  # [T]
         cache_k, cache_v = state["cache_k"], state["cache_v"]
@@ -574,7 +619,7 @@ def beam_adapter(hps: HParams):
             # cross attention against the precomputed per-layer K/V
             cross_out, attn_dist = cross_attend_layer(
                 hps, layer, y, enc_one.cross_k[li], enc_one.cross_v[li],
-                enc_mask)
+                enc_mask, nb=nb)
             y = y + cross_out
             y = y + _ffn_block(layer["ffn"], _ln(layer["ln2"], y))
             cross_ctx = cross_out
@@ -588,6 +633,29 @@ def beam_adapter(hps: HParams):
                            state={"cache_k": cache_k, "cache_v": cache_v})
 
     return init_state, step
+
+
+#: the length-masked slot-decode adapter (ISSUE 11): the shared
+#: protocol wrapper threads the traced block count into this family's
+#: step, where it bounds the per-layer cross-attention block chain
+beam_adapter_masked = models_lib.masked_adapter(beam_adapter)
+
+
+def pad_enc_view(enc_view: TransformerEncView, t_target: int,
+                 ) -> TransformerEncView:
+    """Zero-pad a bucket-width encoder view's key axis to ``t_target``
+    (the prefill -> pack hand-off, decode/beam_search.prefill_jit): the
+    padded K/V positions sit behind the valid-length mask, so they are
+    never attended — zeros keep the 0-weight context products exact."""
+    def pad(x):
+        if x.shape[2] >= t_target:
+            return x
+        widths = [(0, 0)] * x.ndim
+        widths[2] = (0, t_target - x.shape[2])
+        return jnp.pad(x, widths)
+
+    return TransformerEncView(cross_k=pad(enc_view.cross_k),
+                              cross_v=pad(enc_view.cross_v))
 
 
 # --------------------------------------------------------------------------

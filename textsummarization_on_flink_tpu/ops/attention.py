@@ -89,13 +89,26 @@ def attend_shared(attn_params: Dict[str, Array], enc_states: Array,
                   enc_feats: Array, enc_mask: Array,
                   dec_state: Tuple[Array, Array],
                   coverage: Optional[Array], use_coverage: bool,
+                  nb: Optional[Array] = None, block: int = 0,
                   ) -> Tuple[Array, Array, Optional[Array]]:
     """attend() with the encoder tensors shared across the K query rows
     (decode byte diet, ISSUE 7): enc_states/enc_feats [T, D] and
     enc_mask [T] carry no query axis, dec_state leaves are [K, H],
     coverage [K, T].  The beam search's per-hypothesis queries broadcast
     against ONE per-article encoder copy — same numerics as attend() on
-    a K-fold broadcast, without the K-fold HBM stream."""
+    a K-fold broadcast, without the K-fold HBM stream.
+
+    Length-masked slot decode (prefill/decode disaggregation, ISSUE 11):
+    an explicit ``nb`` (traced scalar int32 — the number of active
+    `block`-position encoder key blocks, ceil(valid_len / block)) routes
+    through the BLOCKED formula: each block's energies/context matmul is
+    gated by a real XLA conditional on ``b < nb``, so the work executed
+    (and the bytes streamed) scales with the longest active resident's
+    TRUE article length instead of the padded T.  Positions in inactive
+    blocks stay at the masked energy floor, exactly where enc_mask=0
+    positions sit in the dense path — so the result is numerically the
+    dense attend's (context differs only by block-wise partial-sum
+    association).  nb=None keeps the dense fused path."""
     c, h = dec_state
     dec_in = jnp.concatenate([c, h], axis=-1)
     dec_feats = dec_in @ attn_params["linear_kernel"] + attn_params["linear_bias"]
@@ -103,10 +116,71 @@ def attend_shared(attn_params: Dict[str, Array], enc_states: Array,
     cov_in = (coverage if apply_cov
               else jnp.zeros((dec_in.shape[0], enc_mask.shape[0]),
                              jnp.float32))
-    context, attn_dist = pallas_attention.fused_attention_shared(
-        enc_states, enc_feats, enc_mask, dec_feats.astype(jnp.float32),
-        cov_in, attn_params["v"], attn_params["w_c"], apply_cov)
+    if nb is None:
+        context, attn_dist = pallas_attention.fused_attention_shared(
+            enc_states, enc_feats, enc_mask, dec_feats.astype(jnp.float32),
+            cov_in, attn_params["v"], attn_params["w_c"], apply_cov)
+    else:
+        context, attn_dist = _attend_shared_blocked(
+            enc_states, enc_feats, enc_mask, dec_feats.astype(jnp.float32),
+            cov_in, attn_params["v"], attn_params["w_c"], apply_cov,
+            nb, block)
     new_coverage = None
     if use_coverage:
         new_coverage = (coverage if coverage is not None else 0.0) + attn_dist
     return context, attn_dist, new_coverage
+
+
+NEG = -1e30  # masked-energy floor (matches pallas_attention.NEG)
+
+
+def _attend_shared_blocked(enc_states: Array, enc_feats: Array,
+                           enc_mask: Array, dec_feats: Array, coverage: Array,
+                           v: Array, w_c: Array, use_coverage: bool,
+                           nb: Array, block: int,
+                           ) -> Tuple[Array, Array]:
+    """The shared-encoder reference formula over a conditional chain of
+    `block`-position encoder key blocks (length-masked slot decode).
+
+    The chain is STATICALLY unrolled (python loop over ceil(T/block)
+    blocks, each a `lax.cond` on the traced, query-uniform ``b < nb``),
+    so the compiled step is ONE executable whose runtime FLOPs/bytes
+    scale with nb — XLA conditionals with an unbatched predicate survive
+    the slot vmap as real branches, and HloCostAnalysis prices each
+    block once, which is what makes decode_step_cost's length axis
+    faithful.  Energies land in a NEG-initialized [K, T] buffer:
+    uncovered blocks sit at the same floor the dense path's enc_mask=0
+    positions do, so softmax weights there are exactly 0 and the
+    skipped context blocks contribute exactly nothing.  Forward-only,
+    XLA-only (the masked slot path never routes to Pallas)."""
+    K = dec_feats.shape[0]
+    T = enc_mask.shape[0]
+    block = max(1, min(int(block) or T, T))
+    nblocks = -(-T // block)
+    e = jnp.full((K, T), NEG, jnp.float32)
+    for b in range(nblocks):
+        lo, hi = b * block, min((b + 1) * block, T)
+
+        def write_block(e, lo=lo, hi=hi):
+            feats = enc_feats[lo:hi].astype(jnp.float32)[None, :, :] \
+                + dec_feats[:, None, :]
+            if use_coverage:
+                feats = feats + coverage[:, lo:hi, None] * w_c[None, None, :]
+            eb = jnp.sum(v * jnp.tanh(feats), axis=-1)  # [K, hi-lo]
+            eb = jnp.where(enc_mask[lo:hi][None, :] > 0, eb, NEG)
+            return e.at[:, lo:hi].set(eb)
+
+        e = jax.lax.cond(b < nb, write_block, lambda e: e, e)
+    e = e - jax.lax.stop_gradient(jnp.max(e, axis=-1, keepdims=True))
+    p = jnp.exp(e) * (enc_mask[None, :] > 0)
+    attn = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    context = jnp.zeros((K, enc_states.shape[-1]), jnp.float32)
+    for b in range(nblocks):
+        lo, hi = b * block, min((b + 1) * block, T)
+
+        def add_block(ctx, lo=lo, hi=hi):
+            return ctx + attn[:, lo:hi] @ enc_states[lo:hi].astype(
+                jnp.float32)
+
+        context = jax.lax.cond(b < nb, add_block, lambda ctx: ctx, context)
+    return context, attn

@@ -17,6 +17,11 @@ is reduced across the mesh) a *wire dtype* annotation:
   grads           same tree rule as params     ``hps.grad_allreduce_dtype``
   beam output     dp over articles             —
   slot state      dp over resident slots       —
+  prefill batch   dp over prefill rows at      —
+                  bucket shapes (replicated
+                  for 1-article serving
+                  prefills)
+  prefill state   same leading-axis rule       —
 
 Consumers: the unified train/eval step builders (parallel/mesh.py), the
 serving paths (`make_sharded_beam_search`, `decode/decoder.py`'s
@@ -75,7 +80,8 @@ ENC_BATCH_NAMES = ("enc_batch", "enc_lens", "enc_padding_mask",
 #: Every role the registry answers for (`ShardingRegistry.table()`
 #: documents each; tests assert coverage).
 ROLES = ("params", "opt_state", "step", "train_batch", "eval_batch",
-         "metrics", "grads", "beam_output", "slot_state")
+         "metrics", "grads", "beam_output", "slot_state",
+         "prefill_batch", "prefill_state")
 
 
 # --------------------------------------------------------------------------
@@ -240,6 +246,30 @@ class ShardingRegistry:
         in the slot loop)."""
         return {k: P("dp") for k in ENC_BATCH_NAMES}
 
+    # -- prefill/decode disaggregation (ISSUE 11) --
+    def prefill_batch_spec(self, rows: int = 1) -> P:
+        """PREFILL-stage placement rule: bucket-shaped encoder arrays
+        batch-shard over dp when the prefill batch divides the axis;
+        the continuous engine's one-article prefill replicates (its
+        [1, bucket] leaves cannot split, and dp's job in serving is
+        sharding the RESIDENT slots — the two stages place separately
+        from this one table)."""
+        return P("dp") if rows >= self.dp and rows % self.dp == 0 else P()
+
+    def prefill_batch_specs(self, rows: int = 1) -> Dict[str, P]:
+        spec = self.prefill_batch_spec(rows)
+        return {k: spec for k in ENC_BATCH_NAMES}
+
+    def prefill_state_specs(self, pre: PyTree) -> PyTree:
+        """Specs for a PrefillState (padded encoder view + valid
+        length, leading axis = the prefill batch): same leading-axis
+        rule as the input arrays, so a prefilled article lands where
+        pack_slot_jit's scatter into the dp-sharded resident state
+        expects it."""
+        rows = jax.tree_util.tree_leaves(pre)[0].shape[0]
+        spec = self.prefill_batch_spec(rows)
+        return jax.tree_util.tree_map(lambda _: spec, pre)
+
     def wire_dtype(self, role: str = "grads"):
         return wire_dtype(self.hps, role)
 
@@ -290,6 +320,12 @@ class ShardingRegistry:
              "wire": w},
             {"role": "beam_output", "spec": "P('dp')", "wire": "-"},
             {"role": "slot_state", "spec": "P('dp')", "wire": "-"},
+            {"role": "prefill_batch",
+             "spec": "P('dp') at bucket shapes when the prefill batch "
+                     "divides dp, else P()", "wire": "-"},
+            {"role": "prefill_state", "spec": "same leading-axis rule "
+                                              "as prefill_batch",
+             "wire": "-"},
         ]
         return rows
 

@@ -27,11 +27,17 @@ contract as data/batcher.py trickle padding).
 from __future__ import annotations
 
 import time
-from typing import Any, List, Optional
+from collections import deque
+from typing import Any, Deque, List, Optional, Tuple
 
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.obs import flightrec
-from textsummarization_on_flink_tpu.config import HParams, parse_bucket_spec
+from textsummarization_on_flink_tpu.config import (
+    HParams,
+    parse_bucket_spec,
+)
+from textsummarization_on_flink_tpu.config import bucket_for as \
+    config_bucket_for
 from textsummarization_on_flink_tpu.data.batching import Batch
 from textsummarization_on_flink_tpu.data.vocab import Vocab
 from textsummarization_on_flink_tpu.resilience.errors import (
@@ -81,11 +87,10 @@ class MicroBatcher:
 
     def bucket_for(self, enc_len: int) -> int:
         """Smallest bucket covering `enc_len` (SummaryExample.build has
-        already truncated to max_enc_steps == buckets[-1])."""
-        for b in self.buckets:
-            if enc_len <= b:
-                return b
-        return self.buckets[-1]
+        already truncated to max_enc_steps == buckets[-1]).  Routes
+        through config.bucket_for — the continuous engine's prefill
+        stage shares the same rule."""
+        return config_bucket_for(self.buckets, enc_len)
 
     def next_group(self, poll: float = 0.05) -> Optional[List[ServeRequest]]:
         """The next micro-batch worth of requests, or None after an idle
@@ -146,11 +151,19 @@ class ContinuousBatcher:
 
       1. evicts residents whose Deadline expired (typed
          ``DeadlineExceededError``, ``serve/deadline_evictions_total``);
-      2. refills free slots straight off the RequestQueue — a request
-         admitted mid-decode starts at the NEXT chunk boundary, not the
-         next batch;
-      3. advances every resident slot one chunk through the engine;
-      4. harvests finished slots — each future resolves the moment ITS
+      2. PREFILLS queued requests through the engine's bucketed encoder
+         stage (ISSUE 11) into a small ready queue — encoder cost paid
+         at the article's bucket shape, ``serve_prefill_depth`` entries
+         ahead of the free slots so a freed slot refills from an
+         already-encoded article (``serve/prefill_*`` metrics; engines
+         without a ``prefill`` surface — stubs, the SLO gate's
+         uniform-baseline sim — keep the direct-pack path);
+      3. refills free slots from the prefill queue (or straight off the
+         RequestQueue on legacy engines) — a request admitted
+         mid-decode starts at the NEXT chunk boundary, not the next
+         batch;
+      4. advances every resident slot one chunk through the engine;
+      5. harvests finished slots — each future resolves the moment ITS
          sequence completes, independent of its neighbors.
 
     The engine (decode/decoder.SlotDecodeEngine, or a test stub) owns
@@ -175,6 +188,14 @@ class ContinuousBatcher:
         self.slots = int(engine.slots)
         self._resident: List[Optional[ServeRequest]] = [None] * self.slots
         self._chunks = [0] * self.slots  # chunks each resident has seen
+        # the prefill queue (ISSUE 11): requests whose bucketed encoder
+        # pass already ran, awaiting a free slot.  Engines without a
+        # prefill surface (stub engines, the SLO gate's uniform
+        # baseline) keep the legacy direct-pack refill.
+        self._supports_prefill = hasattr(engine, "prefill")
+        self._prefilled: Deque[Tuple[ServeRequest, Any]] = deque()
+        self._prefill_depth = max(
+            0, int(getattr(hps, "serve_prefill_depth", 0)))
         self._tick = 0  # scheduler rounds (the T of "refill at tick T")
         # per-tick activity, reset each tick for the flight-recorder
         # frame (obs/flightrec.py): post-mortems need the rounds BEFORE
@@ -195,6 +216,20 @@ class ContinuousBatcher:
             buckets=[1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0])
         self._c_refills = reg.counter("serve/slot_refills_total")
         self._c_evictions = reg.counter("serve/deadline_evictions_total")
+        # prefill-stage telemetry (SERVING.md "Prefill/decode
+        # disaggregation"): volume, failures, and WHICH bucket each
+        # article's encoder pass ran at — the disaggregation evidence
+        # (a bucket histogram pinned at max_enc_steps means the stage
+        # is not routing short articles to short shapes)
+        self._c_prefills = reg.counter("serve/prefill_total")
+        self._c_prefill_errors = reg.counter("serve/prefill_errors_total")
+        # bucketed on the serve buckets themselves (length-scaled):
+        # the default time-scaled bounds would dump every token-length
+        # observation into +inf and blind the percentiles
+        self._h_prefill_bucket = reg.histogram(
+            "serve/prefill_bucket_len",
+            buckets=[float(b) for b in resolve_buckets(hps)])
+        self._g_prefill_ready = reg.gauge("serve/prefill_ready")
         self._h_queue_time = reg.histogram("serve/time_in_queue_seconds")
         self._h_e2e = reg.histogram("serve/e2e_latency_seconds")
         self._c_done = reg.counter("serve/completed_total")
@@ -202,6 +237,14 @@ class ContinuousBatcher:
 
     def busy(self) -> bool:
         return any(r is not None for r in self._resident)
+
+    def pending(self) -> bool:
+        """True while prefilled-but-unslotted requests await a slot —
+        part of the drain condition: a tick can harvest EVERY resident
+        after the prefill stage drained the queue's tail into the
+        prefill queue, and those entries are admitted work the loop
+        must keep ticking for (they pack on the next refill)."""
+        return bool(self._prefilled)
 
     def _set_active_gauge(self) -> None:
         self._g_active.set(sum(r is not None for r in self._resident))
@@ -238,48 +281,115 @@ class ContinuousBatcher:
                               evicted=evicted, tick=self._tick)
         self._set_active_gauge()
 
+    def _next_live(self, may_block: bool, poll: float,
+                   ) -> Optional[ServeRequest]:
+        """Pop the next LIVE request off the RequestQueue, resolving
+        queue-expired ones typed on the way (the ISSUE-6 eviction site).
+        Queue time is observed for EVERY dequeued request — including
+        the expired ones, whose long waits are exactly the histogram
+        tail that shows queue pressure — and the admit event fires only
+        for live requests (a queue-expired request's timeline is
+        enqueue -> evict -> resolve, never admit -> evict, so bench's
+        admit-anchored resident split can't count eviction latency as
+        decode time)."""
+        while True:
+            req = (self._q.get(timeout=poll) if may_block
+                   else self._q.get_nowait())
+            may_block = False
+            if req is None:
+                return None
+            queue_s = time.monotonic() - req.enqueue_t
+            self._h_queue_time.observe(queue_s)
+            if req.deadline.expired():  # died waiting in the queue
+                self._c_evictions.inc()
+                self._tick_evictions += 1
+                obs.spans.request_event(
+                    self._reg, "evict", req.trace, req.uuid,
+                    where="queue")
+                req.future._reject(DeadlineExceededError(
+                    f"request {req.uuid!r} deadline expired while "
+                    f"queued"))
+                continue
+            obs.spans.request_event(
+                self._reg, "admit", req.trace, req.uuid,
+                queue_ms=round(queue_s * 1e3, 3))
+            return req
+
+    def _prefill_stage(self, poll: float) -> None:
+        """Run the bucketed PREFILL stage (ISSUE 11): pop queued
+        requests and push them through the engine's encoder pass at
+        their bucket shape, up to free-slots + ``serve_prefill_depth``
+        ready entries — the lookahead that overlaps next admissions'
+        encoder work with resident decode.  Blocks at most once and
+        only while the engine is fully idle.  A prefill failure rejects
+        ITS request typed and re-raises so the server's tick handler
+        applies the standard dispatch-failure blast radius
+        (fail_resident) to the engine."""
+        if not self._supports_prefill:
+            return
+        free = sum(r is None for r in self._resident)
+        target = free + self._prefill_depth
+        may_block = not self.busy() and not self._prefilled
+        while len(self._prefilled) < target:
+            req = self._next_live(may_block, poll)
+            may_block = False
+            if req is None:
+                break
+            try:
+                with obs.spans.span(self._reg, "serve/prefill"):
+                    pre = self._engine.prefill(req.example)
+            except Exception as e:
+                # the request left the queue but never became resident:
+                # resolve it HERE, then let the server's dispatch-
+                # failure handling deal with the engine state
+                self._c_prefill_errors.inc()
+                self._c_errors.inc()
+                req.future._reject(e)
+                raise
+            bucket = int(getattr(pre, "bucket", req.example.enc_len))
+            self._c_prefills.inc()
+            self._h_prefill_bucket.observe(bucket)
+            obs.spans.request_event(
+                self._reg, "prefill", req.trace, req.uuid, bucket=bucket)
+            self._prefilled.append((req, pre))
+        self._g_prefill_ready.set(len(self._prefilled))
+
     def _refill(self, poll: float) -> None:
-        """Admit queued requests into every free slot.  Blocks at most
-        once (`poll` seconds) and only while the engine is idle — under
-        load the queue is polled non-blocking so a refill never stalls
-        resident decodes.  Queued requests whose Deadline already
-        expired are resolved typed here instead of wasting a slot."""
+        """Admit requests into every free slot — from the prefill queue
+        (disaggregated engines) or straight off the RequestQueue
+        (legacy engines; blocks at most once, `poll` seconds, and only
+        while the engine is idle — under load the queue is polled
+        non-blocking so a refill never stalls resident decodes).
+        Requests whose Deadline expired while awaiting a slot are
+        resolved typed here instead of wasting one."""
         may_block = not self.busy()
         for idx in range(self.slots):
             if self._resident[idx] is not None:
                 continue
             while True:
-                req = (self._q.get(timeout=poll) if may_block
-                       else self._q.get_nowait())
-                may_block = False  # one blocking poll per tick
-                if req is None:
-                    return
-                # queue time observed for EVERY dequeued request —
-                # including the expired ones below, whose long waits are
-                # exactly the histogram tail that shows queue pressure
-                # (same population as the micro-batch dispatch path)
-                queue_s = time.monotonic() - req.enqueue_t
-                self._h_queue_time.observe(queue_s)
-                if req.deadline.expired():  # died waiting in the queue
-                    self._c_evictions.inc()
-                    self._tick_evictions += 1
-                    obs.spans.request_event(
-                        self._reg, "evict", req.trace, req.uuid,
-                        where="queue")
-                    req.future._reject(DeadlineExceededError(
-                        f"request {req.uuid!r} deadline expired while "
-                        f"queued"))
-                    continue
-                # admit ONLY for live requests (mirror the micro-batch
-                # dispatch path): a queue-expired request's timeline is
-                # enqueue -> evict -> resolve, never admit -> evict, so
-                # bench's admit-anchored resident split can't count
-                # eviction latency as decode time
-                obs.spans.request_event(
-                    self._reg, "admit", req.trace, req.uuid,
-                    queue_ms=round(queue_s * 1e3, 3))
+                if self._supports_prefill:
+                    if not self._prefilled:
+                        self._g_prefill_ready.set(0)
+                        return
+                    req, payload = self._prefilled.popleft()
+                    if req.deadline.expired():  # aged out awaiting a slot
+                        self._c_evictions.inc()
+                        self._tick_evictions += 1
+                        obs.spans.request_event(
+                            self._reg, "evict", req.trace, req.uuid,
+                            where="prefilled")
+                        req.future._reject(DeadlineExceededError(
+                            f"request {req.uuid!r} deadline expired "
+                            f"awaiting a free slot (prefilled)"))
+                        continue
+                else:
+                    req = self._next_live(may_block, poll)
+                    may_block = False  # one blocking poll per tick
+                    if req is None:
+                        return
+                    payload = req.example
                 try:
-                    self._engine.pack(idx, req.example)
+                    self._engine.pack(idx, payload)
                 except Exception as e:
                     # the request left the queue but never became
                     # resident: resolve it HERE, then let the server's
@@ -298,6 +408,8 @@ class ContinuousBatcher:
                     self._reg, "slot", req.trace, req.uuid, slot=idx,
                     tick=self._tick)
                 break
+        if self._supports_prefill:
+            self._g_prefill_ready.set(len(self._prefilled))
         self._set_active_gauge()
 
     def _harvest(self, finished: List[int]) -> None:
@@ -324,7 +436,8 @@ class ContinuousBatcher:
         flightrec.record(
             self._reg, "serve_tick", tick=self._tick,
             occupancy=round(occupancy, 4), queue_depth=self._q.qsize(),
-            evictions=self._tick_evictions, refills=self._tick_refills)
+            evictions=self._tick_evictions, refills=self._tick_refills,
+            prefilled=len(self._prefilled))
 
     def tick(self, poll: float = 0.05) -> bool:
         """One scheduler round: evict -> refill -> step -> harvest.
@@ -335,6 +448,7 @@ class ContinuousBatcher:
         self._tick_evictions = 0
         self._tick_refills = 0
         self._evict_expired()
+        self._prefill_stage(poll)
         self._refill(poll)
         if not self.busy():
             return False
@@ -361,7 +475,9 @@ class ContinuousBatcher:
         """Reject EVERY resident request with `error` and free its slot
         (the continuous analogue of the micro-batch 'a failed dispatch
         fails its batch only'); returns the count rejected.  The engine
-        keeps its (masked-out) state; the next pack overwrites it."""
+        keeps its (masked-out) state; the next pack overwrites it.
+        Prefilled-but-unslotted requests are NOT part of the failing
+        dispatch and stay queued for the next tick."""
         n = 0
         for idx, req in enumerate(self._resident):
             if req is None:
@@ -372,4 +488,21 @@ class ContinuousBatcher:
             n += 1
         self._c_errors.inc(n)
         self._set_active_gauge()
+        return n
+
+    def fail_pending(self, error: BaseException) -> int:
+        """Reject every PREFILLED-but-unslotted request with `error` —
+        the shutdown backstop: if the dispatch thread dies with entries
+        still in the prefill queue, their futures must not hang (the
+        exactly-once contract).  Normal drains never get here: refill
+        empties the prefill queue into free slots before the loop can
+        observe an idle engine."""
+        n = 0
+        while self._prefilled:
+            req, _ = self._prefilled.popleft()
+            req.future._reject(error)
+            n += 1
+        if n:
+            self._c_errors.inc(n)
+            self._g_prefill_ready.set(0)
         return n

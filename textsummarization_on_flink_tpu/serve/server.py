@@ -22,10 +22,14 @@ admission-controlled queue (serve/queue.py) through the engine
     the shape buckets;
   * ``continuous`` — a persistent slotted decode loop
     (serve/batcher.ContinuousBatcher over decode/decoder.
-    SlotDecodeEngine): free slots refill straight off the queue at
-    chunk boundaries, each future resolves the moment ITS sequence
+    SlotDecodeEngine): queued requests run a bucketed PREFILL stage
+    (encoder + cross-attention cache at the article's serve bucket,
+    ISSUE 11) into a small ready queue, free slots refill from it at
+    chunk boundaries, and resident decode is length-masked — per-chunk
+    cost follows the longest active article's true length, not the
+    padded shape; each future resolves the moment ITS sequence
     finishes — no dispatch-window straggler barrier (SERVING.md
-    "Continuous batching").
+    "Continuous batching" / "Prefill/decode disaggregation").
 
 Contracts (both modes):
   * every admitted request resolves EXACTLY ONCE — with a
@@ -231,6 +235,13 @@ class ServingServer:
             ServeClosedError("server stopped before this request ran"))
         if n:
             self._c_errors.inc(n)
+        if self._cont is not None:
+            # shutdown backstop for the prefill queue (ISSUE 11): a
+            # dispatch thread that died past its join timeout may leave
+            # prefilled-but-unslotted requests behind; their futures
+            # must resolve (fail_pending counts its own errors)
+            self._cont.fail_pending(
+                ServeClosedError("server stopped before this request ran"))
         self._thread = None
         # a stopped server's silence is not a failure: retire the beat
         # so /healthz reflects the components still running
@@ -424,8 +435,14 @@ class ServingServer:
                 n = self._cont.fail_resident(e)
                 log.exception("continuous dispatch tick failed; rejected "
                               "%d resident request(s)", n)
+            # drain condition: queue empty AND no residents AND no
+            # prefilled-but-unslotted requests (a tick can harvest every
+            # resident right after the prefill stage drained the
+            # queue's tail — those entries must pack on the next tick,
+            # not be rejected by stop()'s backstop)
             if (self._stop.is_set() and self._queue.empty()
-                    and not self._cont.busy()):
+                    and not self._cont.busy()
+                    and not self._cont.pending()):
                 return
             try:
                 # same hot-swap cadence as the micro-batch loop (the

@@ -47,7 +47,15 @@ DEFAULT: Dict[str, Any] = {
                 # request's chunk cadence
                 r"^ContinuousBatcher\.(tick|_refill|_harvest|_evict_expired)$",
                 r"^ServingServer\._run_continuous$",
-                r"^SlotDecodeEngine\.(pack|step|unpack)$",
+                r"^SlotDecodeEngine\.(pack|step|unpack|prefill)$",
+                # prefill/decode disaggregation (ISSUE 11): the prefill
+                # stage runs once per admission on the dispatch thread,
+                # and the blocked/masked attention closures trace into
+                # every decode chunk — a host sync (or trace-time side
+                # effect) in any of them stalls resident decodes
+                r"^ContinuousBatcher\._prefill_stage$",
+                r"^_attend_shared_blocked",
+                r"^cross_attend_layer",
                 # the telemetry plane's own per-tick/per-step code
                 # (ISSUE 9): frame recording and heartbeats run inside
                 # every hot loop above — a host sync smuggled into THEM
