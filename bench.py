@@ -365,6 +365,30 @@ def _config_fingerprint() -> dict:
         # non-default so pre-existing banked records keep matching.
         if os.environ.get("BENCH_SERVE_TIER", "beam") != "beam":
             fp["tier"] = os.environ["BENCH_SERVE_TIER"]
+            # distilled-narrow-draft axes (ISSUE 12): a narrow draft
+            # (different width + factored head = different compiled
+            # programs) and an adaptive controller (host-stepped cycle
+            # loop vs one dispatch) must never cross-substitute —
+            # added only when non-default, per house convention, so
+            # banked equal-width spec records keep matching.  The
+            # EFFECTIVE rank rides along whenever a factored head is in
+            # play (explicit BENCH_DRAFT_RANK, or the width-derived
+            # default — same resolution bench_serve applies), so two
+            # ranks can never share a fingerprint.  Guarded to the
+            # tiers that BUILD a draft (spec/draft): greedy/legacy runs
+            # ignore BENCH_DRAFT_*, and a stray env var must not split
+            # identical workloads across fingerprints (the PR-11
+            # short_ratio rule).
+            if os.environ["BENCH_SERVE_TIER"] in ("spec", "draft"):
+                dh = int(os.environ.get("BENCH_DRAFT_HIDDEN", "0"))
+                dr = int(os.environ.get("BENCH_DRAFT_RANK", str(dh // 2)))
+                if dh:
+                    fp["draft_hidden"] = dh
+                if dr:
+                    fp["draft_rank"] = dr
+                if os.environ.get("BENCH_SPEC_ADAPTIVE", "").lower() in \
+                        ("1", "on", "true", "yes"):
+                    fp["spec_k_adaptive"] = True
         # bimodal short-request fraction (ISSUE 11): a different mix is
         # a different workload — a 7/8-short measurement must never
         # stand in for the default 3/4-short ask.  Recorded as the
@@ -1367,10 +1391,20 @@ def bench_serve() -> None:
         # the draft model source: the mapped bootstrap for the
         # transformer family (the real serving recipe), fresh init for
         # the others (exactness holds either way; acceptance is the
-        # row's evidence, not an assumption)
+        # row's evidence, not an assumption).  BENCH_DRAFT_HIDDEN /
+        # BENCH_DRAFT_RANK / BENCH_SPEC_ADAPTIVE bench the ISSUE-12
+        # narrow draft + adaptive controller (fingerprinted above when
+        # non-default).
+        draft_hidden = int(os.environ.get("BENCH_DRAFT_HIDDEN", "0"))
+        draft_rank = int(os.environ.get(
+            "BENCH_DRAFT_RANK", str(draft_hidden // 2)))
+        adaptive = os.environ.get("BENCH_SPEC_ADAPTIVE", "").lower() in \
+            ("1", "on", "true", "yes")
         hps = hps.replace(
             spec_draft="map" if hps.model_family == "transformer"
-            else "fresh")
+            else "fresh",
+            draft_hidden=draft_hidden, draft_vocab_rank=draft_rank,
+            spec_k_adaptive=adaptive)
     hps.validate()
     if hps.model_family == "transformer":
         hps = hps.replace(coverage=False)
@@ -1463,6 +1497,7 @@ def bench_serve() -> None:
             drafted0 = reg.counter("decode/spec_draft_tokens_total").value
             accepted0 = reg.counter(
                 "decode/spec_accepted_tokens_total").value
+            cycles0 = reg.counter("decode/spec_cycles_total").value
             lat: list = []
             # trace-derived per-request breakdown (ISSUE 9 satellite):
             # TEE the timed phase's lifecycle events into memory (an
@@ -1602,10 +1637,19 @@ def bench_serve() -> None:
                 "decode/spec_draft_tokens_total").value - drafted0)
             accepted = int(reg.counter(
                 "decode/spec_accepted_tokens_total").value - accepted0)
+            cycles = int(reg.counter(
+                "decode/spec_cycles_total").value - cycles0)
             accept_rate = (accepted / drafted) if drafted else 0.0
             rec["draft_tokens"] = drafted
             rec["accepted_tokens"] = accepted
             rec["accept_rate"] = round(accept_rate, 4)
+            # realized mean spec_k (ISSUE 12): drafted tokens are the
+            # per-cycle k summed, so the mean k the engine ACTUALLY ran
+            # — equals hps.spec_k statically, walks the committed
+            # bounds under the adaptive controller
+            rec["spec_cycles"] = cycles
+            rec["spec_k_mean"] = (round(drafted / cycles, 3) if cycles
+                                  else 0.0)
             try:
                 budget_path = os.path.join(
                     os.path.dirname(os.path.abspath(__file__)),

@@ -76,6 +76,15 @@ echo "== speculative-tier smoke (draft init -> spec decode -> exactness)"
 # enforced in the suite above)
 python scripts/spec_smoke.py
 
+echo "== distill-spec smoke (narrow draft distilled -> adaptive spec decode)"
+# the ISSUE-12 fast path end to end: a tiny teacher trained on synthetic
+# copy data, the NARROW draft (half width + factored vocab head)
+# distilled from its greedy outputs through train/distill.DistillTrainer,
+# then acceptance-adaptive spec decode asserted token-exact with greedy
+# (the committed FLOPs-ratio and acceptance-floor gates live in
+# BYTE_BUDGET.json's spec section, enforced in the suite above)
+python scripts/spec_smoke.py --distill
+
 echo "== live-plane smoke (/metrics + /healthz scrape over a continuous run)"
 # the ISSUE-9 exposition plane end to end: scrape-vs-render_text byte
 # parity, healthz component heartbeats, and one uuid's trace timeline

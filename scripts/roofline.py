@@ -137,9 +137,15 @@ def hps_for(tag: str, bench_mod):
         hps = HParams(batch_size=batch, compute_dtype="bfloat16",
                       **bench_mod._preset_overrides())
         if tag in SPEC_CONFIGS:
-            # the committed draft recipe: 1 kept layer (BYTE_BUDGET.json
-            # spec.draft_overrides), spec_k from the HParams default
-            return hps.replace(mode="decode", draft_dec_layers=1)
+            # the committed REFERENCE-scale draft recipe (BYTE_BUDGET.json
+            # spec.ref_overrides: 1 kept layer, H/2-wide narrow draft,
+            # rank-64 factored head — ISSUE 12), spec_k from the HParams
+            # default; read from the budget so this row and the gate can
+            # never describe two different drafts
+            budget_path = os.path.join(REPO, "BYTE_BUDGET.json")
+            with open(budget_path, encoding="utf-8") as f:
+                ref_overrides = json.load(f)["spec"]["ref_overrides"]
+            return hps.replace(mode="decode", **ref_overrides)
         return hps.replace(mode="decode") if tag in DECODE_CONFIGS else hps
     finally:
         for k, v in saved.items():

@@ -425,7 +425,10 @@ def test_spec_expected_speedup_curve_is_honest(budget):
     """The committed acceptance->speedup curve must equal the model
     formula evaluated at the committed REFERENCE-scale analytic
     draft/full ratio, and that ratio must still be reproduced by the
-    analytic step-FLOPs model (no compile — instant)."""
+    analytic step-FLOPs model (no compile — instant).  Ref scale uses
+    ``ref_overrides`` (H/2-wide draft, rank-64 factored head — the
+    distilled-narrow-draft recipe at H=256), not the gate-scale
+    ``draft_overrides``."""
     from textsummarization_on_flink_tpu.decode.speculative import (
         expected_speedup,
     )
@@ -433,7 +436,7 @@ def test_spec_expected_speedup_curve_is_honest(budget):
     spec = budget["spec"]
     k = int(spec["spec_k"])
     ref = HParams(model_family="transformer",
-                  **spec["draft_overrides"])
+                  **spec["ref_overrides"])
     got_ratio = (_analytic_step_flops(derive_draft_hps(ref))
                  / _analytic_step_flops(ref))
     want_ratio = spec["ref_analytic_ratio"]["transformer"]
@@ -447,6 +450,20 @@ def test_spec_expected_speedup_curve_is_honest(budget):
             f"committed expected_speedup[{alpha}]={want} no longer "
             f"matches the formula ({recomputed:.4f}) — the curve and "
             f"the model drifted apart")
+
+
+def test_spec_narrow_draft_meets_issue12_bar(budget):
+    """The ISSUE-12 acceptance bar, pinned against the committed
+    numbers themselves: the transformer draft/full FLOPs ceiling is at
+    most 0.5 (down from the equal-width 0.95), the ref-scale analytic
+    ratio sits under it, and the re-pinned curve's FLOPs break-even
+    reaches 0.5 acceptance (speedup >= 1 there — the equal-width draft
+    managed 0.42)."""
+    spec = budget["spec"]
+    assert spec["max_draft_flops_ratio"]["transformer"] <= 0.5
+    assert spec["ref_analytic_ratio"]["transformer"] <= \
+        spec["max_draft_flops_ratio"]["transformer"]
+    assert spec["expected_speedup"]["transformer"]["0.5"] >= 1.0
 
 
 def test_spec_verify_scores_positions_cheaper_than_steps(budget,

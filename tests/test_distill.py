@@ -1,0 +1,263 @@
+"""Sequence-level distillation of the narrow draft (ISSUE 12).
+
+The committed BYTE_BUDGET.json ``spec.distill`` gate: a tiny
+transformer teacher is trained on a LEARNABLE synthetic task (copy the
+article prefix — the pointer mechanism's native move), the narrow
+draft (draft_hidden < H, factored vocab head) is distilled on the
+teacher's greedy outputs through the shared
+``transformer.train_output_tail`` loss head, and the measured
+acceptance rate on a HELD-OUT synthetic set must clear the committed
+floor — while the undistilled fresh draft must sit far below it, so
+the gate measures distillation, not luck.  Plus DistillTrainer
+mechanics: the (full, draft) checkpoint-pair sidecar, the
+teacher-array feed-back rules, and token exactness of the distilled
+spec tier.
+"""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import jax
+import pytest
+
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.checkpoint.checkpointer import (
+    Checkpointer,
+)
+from textsummarization_on_flink_tpu.config import HParams, derive_draft_hps
+from textsummarization_on_flink_tpu.data.vocab import (
+    START_ID,
+    STOP_ID,
+    UNK_ID,
+)
+from textsummarization_on_flink_tpu.models import avg_attention
+from textsummarization_on_flink_tpu.obs import Registry
+from textsummarization_on_flink_tpu.train import distill
+from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+from tests.test_speculative import assert_spec_matches_greedy, make_arrays
+
+BUDGET_PATH = os.path.join(os.path.dirname(__file__), "..",
+                           "BYTE_BUDGET.json")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs():
+    with obs.use_registry(Registry()) as reg:
+        yield reg
+
+
+@pytest.fixture(scope="module")
+def dbudget():
+    with open(BUDGET_PATH) as f:
+        return json.load(f)["spec"]["distill"]
+
+
+@pytest.fixture(scope="module")
+def dhparams(dbudget) -> HParams:
+    hps = HParams(**dbudget["scale"])
+    hps.validate()
+    return hps
+
+
+class _ArraysBatch:
+    """Minimal ``next_batch`` payload: the distillation path consumes
+    only ``as_arrays()`` (the teacher writes the decoder side)."""
+
+    def __init__(self, arrays):
+        self._arrays = arrays
+
+    def as_arrays(self):
+        return self._arrays
+
+
+class _CycleBatcher:
+    def __init__(self, batches):
+        self._batches = batches
+        self._i = 0
+
+    def next_batch(self):
+        b = self._batches[self._i % len(self._batches)]
+        self._i += 1
+        return b
+
+
+def copy_task_arrays(arr, hps: HParams):
+    """Synthetic supervised task the TEACHER learns first: emit the
+    article's first T_dec-1 extended tokens then STOP — learnable by
+    the pointer mechanism (copy attention), hence a teacher whose
+    greedy function GENERALIZES to held-out articles.  (A random-init
+    teacher's greedy output is an unlearnable hash of the article;
+    distilling it can only memorize — the honest negative case.)"""
+    B = arr["enc_batch"].shape[0]
+    T = hps.max_dec_steps
+    dec = np.zeros((B, T), np.int32)
+    tgt = np.zeros((B, T), np.int32)
+    mask = np.ones((B, T), np.float32)
+    for b in range(B):
+        gen = arr["enc_batch_extend_vocab"][b, :T - 1].astype(np.int64)
+        gen = np.concatenate([gen, [STOP_ID]])
+        inputs = np.concatenate([[START_ID], gen[:-1]])
+        dec[b] = np.where(inputs >= hps.vocab_size, UNK_ID, inputs)
+        tgt[b] = gen
+    return {**{k: v for k, v in arr.items() if k.startswith("enc_")},
+            "dec_batch": dec, "target_batch": tgt,
+            "dec_padding_mask": mask}
+
+
+@pytest.fixture(scope="module")
+def teacher(dbudget, dhparams):
+    """The frozen full model, trained on the copy task for the
+    committed step count (a few seconds on CPU)."""
+    hps = dhparams.replace(mode="train")
+    state = trainer_lib.init_train_state(hps, hps.vocab_size, seed=0)
+    step = jax.jit(trainer_lib.make_train_step(hps))
+    n_batches = int(dbudget["teacher_batches"])
+    data = [copy_task_arrays(make_arrays(dhparams, dhparams.batch_size,
+                                         seed=1000 + s), dhparams)
+            for s in range(n_batches)]
+    for i in range(int(dbudget["teacher_task_steps"])):
+        state, _ = step(state, data[i % n_batches])
+    return jax.device_get(state.params)
+
+
+@pytest.fixture(scope="module")
+def heldout(dhparams):
+    """Articles NEITHER the teacher nor the draft ever saw."""
+    return make_arrays(dhparams, dhparams.batch_size, seed=100)
+
+
+@pytest.fixture(scope="module")
+def distilled(dbudget, dhparams, teacher):
+    """The committed distillation run, through the REAL DistillTrainer
+    (cached teacher: each batch is teacher-decoded once, later epochs
+    pay only the draft step)."""
+    batches = [_ArraysBatch(make_arrays(dhparams, dhparams.batch_size,
+                                        seed=s))
+               for s in range(int(dbudget["distill_batches"]))]
+    with obs.use_registry(Registry()):
+        dt = distill.DistillTrainer(
+            dhparams, dhparams.vocab_size, _CycleBatcher(batches),
+            teacher, cache_teacher=True, seed=7)
+        dt.distill(int(dbudget["distill_steps"]))
+    return jax.device_get(dt.draft_params())
+
+
+# -- the committed gate -----------------------------------------------------
+
+def test_distilled_acceptance_clears_committed_floor(dbudget, dhparams,
+                                                     teacher, heldout,
+                                                     distilled):
+    """THE ISSUE-12 distillation claim: held-out acceptance of the
+    distilled narrow draft at or above the committed floor."""
+    got = distill.acceptance_rate(teacher, distilled, dhparams, heldout)
+    floor = float(dbudget["min_accept_rate"])
+    assert got >= floor, (
+        f"distilled narrow draft's held-out acceptance fell to "
+        f"{got:.3f} (committed floor {floor}) — distillation through "
+        f"the shared loss head stopped transferring the teacher's "
+        f"greedy behavior (see BYTE_BUDGET.json spec._comment)")
+
+
+def test_fresh_draft_sits_below_the_floor(dbudget, dhparams, teacher,
+                                          heldout):
+    """The control: an UNdistilled fresh narrow draft must be far below
+    the floor, or the gate would measure the task, not the training."""
+    dhps = derive_draft_hps(dhparams)
+    fresh = avg_attention.init_params(dhps, dhparams.vocab_size,
+                                      jax.random.PRNGKey(7))
+    got = distill.acceptance_rate(teacher, fresh, dhparams, heldout)
+    assert got <= float(dbudget["max_fresh_accept_rate"]), (
+        f"fresh narrow draft already accepts at {got:.3f} — the gate "
+        f"scale lost its discriminating power; re-pin spec.distill")
+
+
+def test_distilled_spec_output_token_exact(dhparams, teacher, heldout,
+                                           distilled):
+    """Exactness is draft-independent by construction — pinned here for
+    the DISTILLED draft specifically (both quality regimes covered:
+    high-acceptance distilled here, near-zero fresh in
+    test_speculative)."""
+    assert_spec_matches_greedy(teacher, distilled, dhparams, heldout)
+
+
+# -- DistillTrainer mechanics -----------------------------------------------
+
+def test_teacher_arrays_feedback_rules(dhparams, teacher):
+    """Targets keep extended-vocab ids (the pointer loss scores copies
+    against the article); inputs are the targets shifted right behind
+    START and UNK-mapped; the mask covers exactly the teacher's
+    emitted length."""
+    arrays = make_arrays(dhparams, dhparams.batch_size, seed=3)
+    out = distill.teacher_arrays(teacher, dhparams, arrays)
+    V = dhparams.vocab_size
+    B, T = out["dec_batch"].shape
+    assert (out["dec_batch"] < V).all(), "inputs must be UNK-mapped"
+    for b in range(B):
+        n = int(out["dec_padding_mask"][b].sum())
+        assert n >= 1
+        assert out["dec_batch"][b, 0] == START_ID
+        tgt = out["target_batch"][b, :n]
+        inp = out["dec_batch"][b, 1:n]
+        want = np.where(tgt[:n - 1] >= V, UNK_ID, tgt[:n - 1])
+        np.testing.assert_array_equal(inp, want)
+        assert (out["target_batch"][b, n:] == 0).all()
+
+
+def test_checkpoint_pair_roundtrip_and_teacher_guard(dbudget, dhparams,
+                                                     teacher):
+    """The (full, draft) pair contract: the draft checkpoint rides the
+    standard Checkpointer format plus a teacher-fingerprint sidecar;
+    restore resumes the exact state, the loader hands back the params,
+    and a MISMATCHED teacher is refused typed."""
+    batches = [_ArraysBatch(make_arrays(dhparams, dhparams.batch_size,
+                                        seed=s)) for s in range(2)]
+    tmp = tempfile.mkdtemp(prefix="distill_ckpt_")
+    ck = Checkpointer(tmp)
+    dt = distill.DistillTrainer(dhparams, dhparams.vocab_size,
+                                _CycleBatcher(batches), teacher,
+                                checkpointer=ck, cache_teacher=True,
+                                seed=7)
+    dt.distill(4)
+    assert os.path.exists(os.path.join(tmp, distill.TEACHER_SIDECAR))
+    # resume: a new trainer restores the saved draft state
+    dt2 = distill.DistillTrainer(dhparams, dhparams.vocab_size,
+                                 _CycleBatcher(batches), teacher,
+                                 checkpointer=Checkpointer(tmp),
+                                 cache_teacher=True, seed=99)
+    assert int(dt2.state.step) == 4
+    for a, b in zip(jax.tree_util.tree_leaves(dt.draft_params()),
+                    jax.tree_util.tree_leaves(dt2.draft_params())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the serve-side loader verifies the pair
+    loaded = distill.load_distilled_draft(tmp, full_params=teacher)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["out_bias"]),
+        np.asarray(dt.draft_params()["out_bias"]))
+    wrong = dict(teacher)
+    wrong["out_bias"] = np.asarray(teacher["out_bias"]) + 1.0
+    with pytest.raises(ValueError, match="teacher"):
+        distill.load_distilled_draft(tmp, full_params=wrong)
+
+
+def test_distill_metrics_and_nan_watchdog(dhparams, teacher,
+                                          _isolated_obs):
+    """train/distill_steps_total counts steps; a poisoned teacher
+    target stream surfaces the typed NonFiniteLossError through the
+    windowed flush."""
+    batches = [_ArraysBatch(make_arrays(dhparams, dhparams.batch_size,
+                                        seed=0))]
+    dt = distill.DistillTrainer(dhparams, dhparams.vocab_size,
+                                _CycleBatcher(batches), teacher,
+                                cache_teacher=True, seed=7,
+                                metrics_every=2)
+    dt.distill(3)
+    assert _isolated_obs.counter(
+        "train/distill_steps_total").value == 3
+    # poison the draft state -> non-finite loss -> typed error
+    bad = jax.tree_util.tree_map(lambda x: x, dt.state.params)
+    bad["out_bias"] = np.full_like(np.asarray(bad["out_bias"]), np.nan)
+    dt.state = dt.state._replace(params=bad)
+    with pytest.raises(trainer_lib.NonFiniteLossError):
+        dt.distill(2)

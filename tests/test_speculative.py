@@ -185,6 +185,161 @@ def test_spec_compiles_once_across_acceptance_patterns():
         "speculative decode recompiled across acceptance patterns")
 
 
+# -- acceptance-adaptive spec_k (ISSUE 12) ----------------------------------
+
+def _budget_adaptive():
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BYTE_BUDGET.json")
+    with open(path) as f:
+        return json.load(f)["spec"]["adaptive"]
+
+
+class TestAdaptiveSpecK:
+    def test_k_never_leaves_committed_bounds(self):
+        """Property: whatever histogram stream arrives, k stays in
+        [k_min, k_max] (including degenerate all-zero deltas)."""
+        ctl = speculative.SpecKController(2, 3, 6, draft_ratio=0.25)
+        rng = np.random.RandomState(0)
+        for _ in range(200):
+            k = ctl.k
+            hist = rng.randint(0, 5, size=k + 1)
+            if rng.rand() < 0.2:
+                hist[:] = 0
+            ctl.observe(hist, k)
+            assert 2 <= ctl.k <= 6, ctl.k
+
+    def test_trajectory_pinned_deterministic(self):
+        """The committed BYTE_BUDGET.json spec.adaptive trajectories:
+        the k walk under fixed accept sequences at the committed draft
+        ratio is EXACTLY the pinned one, twice (no hidden state, no
+        RNG, no clock)."""
+        ad = _budget_adaptive()
+        cases = {
+            "accept_all_trajectory": lambda k, n: [0] * k + [n],
+            "reject_at_0_trajectory": lambda k, n: [n] + [0] * k,
+            "half_accept_trajectory":
+                lambda k, n: [0] * (k // 2) + [n] + [0] * (k - k // 2),
+        }
+        per = int(ad["cycles_per_round"])
+        for name, hist_fn in cases.items():
+            want = ad[name]
+            for _attempt in range(2):
+                ctl = speculative.SpecKController(
+                    int(ad["k_min"]), int(ad["k_start"]),
+                    int(ad["k_max"]), float(ad["draft_ratio"]))
+                got = []
+                for _ in range(len(want)):
+                    got.append(ctl.observe(hist_fn(ctl.k, per), ctl.k))
+                assert got == want, (name, got, want)
+
+    def test_adaptive_exact_and_converges_up_under_accept_all(self):
+        """The self-draft harness (perfect draft): output stays exactly
+        greedy with k adapting, and over enough batches the controller
+        climbs to spec_k_max."""
+        hps = AAN_HPS.replace(spec_k_adaptive=True, spec_k=2,
+                              spec_k_min=1, spec_k_max=6)
+        hps.validate()
+        family = get_family(hps.model_family)
+        params = family.init_params(hps, hps.vocab_size,
+                                    jax.random.PRNGKey(0))
+        ctl = speculative.SpecKController.from_hps(hps, draft_ratio=0.25)
+        for seed in range(6):
+            arrays = make_arrays(hps, 3, seed=seed)
+            greedy = beam_search.run_beam_search(
+                params, hps.replace(beam_size=1), arrays)
+            out = speculative.run_spec_decode(params, params, hps,
+                                              arrays, controller=ctl)
+            for b in range(3):
+                n = int(greedy.length[b])
+                assert n == int(out.length[b])
+                assert (list(np.asarray(greedy.tokens[b])[:n])
+                        == list(np.asarray(out.tokens[b])[:n]))
+        assert ctl.k == hps.spec_k_max, (ctl.k, ctl.alpha)
+
+    def test_adaptive_exact_and_converges_down_under_reject_at_0(self):
+        """The adversarial out_bias harness (always-rejected draft):
+        output stays exactly greedy and the controller settles at
+        spec_k_min — never paying more than the minimum draft steps
+        for zero expected acceptance."""
+        hps = TF_HPS.replace(spec_k_adaptive=True, spec_k=3,
+                             spec_k_min=1, spec_k_max=5)
+        hps.validate()
+        params, draft = make_models(hps)
+        draft = dict(draft)
+        draft["out_bias"] = draft["out_bias"].at[7].set(1e4)
+        params = dict(params)
+        params["out_bias"] = params["out_bias"].at[7].set(-1e4)
+        ctl = speculative.SpecKController.from_hps(hps, draft_ratio=0.25)
+        for seed in range(3):
+            arrays = make_arrays(hps, 3, seed=seed)
+            greedy = beam_search.run_beam_search(
+                params, hps.replace(beam_size=1), arrays)
+            out = speculative.run_spec_decode(params, draft, hps,
+                                              arrays, controller=ctl)
+            for b in range(3):
+                n = int(greedy.length[b])
+                assert n == int(out.length[b])
+                assert (list(np.asarray(greedy.tokens[b])[:n])
+                        == list(np.asarray(out.tokens[b])[:n]))
+        # (acceptance is NEAR zero, not exactly zero: on some articles
+        # the pointer COPY path re-ranks token 7 into the full model's
+        # greedy choice despite the vocab bias — the zero-acceptance
+        # direction itself is pinned by test_spec_exact_under_reject_at_0)
+        assert ctl.k == hps.spec_k_min, (ctl.k, ctl.alpha)
+
+    def test_warm_set_bounded_one_compile_per_distinct_k(self):
+        """The compile discipline: the cycle kernel compiles once per
+        DISTINCT k the controller visits (carry shapes ride spec_k_max,
+        so k changes never reshape), and repeats at a warm k add
+        nothing."""
+        hps = TF_HPS.replace(spec_k_adaptive=True, spec_k=3,
+                             spec_k_min=1, spec_k_max=5)
+        hps.validate()
+        params, draft = make_models(hps)
+        jax.clear_caches()
+        before = speculative.spec_cycle_jit._cache_size()
+        ks_seen = set()
+
+        class Spy(speculative.SpecKController):
+            def update(self):
+                super().update()
+                ks_seen.add(self.k)
+                return self.k
+
+        ctl = Spy(hps.spec_k_min, hps.spec_k, hps.spec_k_max,
+                  draft_ratio=0.25)
+        ks_seen.add(ctl.k)
+        for seed in range(4):
+            speculative.run_spec_decode(params, draft, hps,
+                                        make_arrays(hps, 3, seed=seed),
+                                        controller=ctl)
+        grown = speculative.spec_cycle_jit._cache_size() - before
+        assert grown == len(ks_seen), (grown, sorted(ks_seen))
+        assert grown <= hps.spec_k_max - hps.spec_k_min + 1
+
+    def test_decoder_accept_hist_buckets_span_k_max(self, _isolated_obs):
+        """The ISSUE-12 satellite fix: the accept-length histogram's
+        buckets cover 0..spec_k_max (resolve_spec_bounds), so adaptive
+        cycles at k > spec_k can't pile into one overflow bin."""
+        import tempfile
+
+        hps = serve_hps(spec_k_adaptive=True, spec_k=2, spec_k_min=1,
+                        spec_k_max=7)
+        family = get_family(hps.model_family)
+        params = family.init_params(hps, hps.vocab_size,
+                                    jax.random.PRNGKey(0))
+        decoder = BeamSearchDecoder(
+            hps, serve_vocab(), batcher=None, params=params,
+            decode_root=tempfile.mkdtemp(prefix="spec_bkt_"))
+        assert decoder._h_accept.buckets == tuple(
+            float(i) for i in range(0, hps.spec_k_max + 1))
+        assert decoder._spec_ctl is not None
+        assert decoder._spec_ctl.k == hps.spec_k
+
+
 # -- AAN family: train/decode consistency + mapped bootstrap ----------------
 
 class TestAvgAttentionFamily:
@@ -248,6 +403,42 @@ class TestAvgAttentionFamily:
             np.testing.assert_array_equal(dst["ffn"]["w1"],
                                           src["ffn"]["w1"])
             assert "aan_ffn" in dst and "aan_gate" in dst
+
+    def test_narrow_mapped_bootstrap_shares_encoder_only(self):
+        """The ISSUE-12 narrow variant: shared H-wide leaves copied
+        verbatim (embedding, encoder, out_bias), the H_d decoder side
+        fresh (emb_proj adapter, factored vocab_head, H_d blocks) —
+        and the spec output is STILL exactly greedy (exactness never
+        depended on draft quality)."""
+        hps = TF_HPS.replace(draft_hidden=4, draft_vocab_rank=4)
+        hps.validate()
+        full = get_family("transformer").init_params(
+            hps, hps.vocab_size, jax.random.PRNGKey(0))
+        dhps = derive_draft_hps(hps)
+        draft = avg_attention.init_from_transformer(
+            full, hps, dhps, jax.random.PRNGKey(1))
+        np.testing.assert_array_equal(draft["embedding"],
+                                      full["embedding"])
+        np.testing.assert_array_equal(
+            draft["encoder"]["layers"][0]["ffn"]["w1"],
+            full["encoder"]["layers"][0]["ffn"]["w1"])
+        assert draft["emb_proj"]["kernel"].shape == (hps.hidden_dim, 4)
+        assert draft["vocab_head"]["w1"].shape == (4, 4)
+        assert draft["vocab_head"]["w2"].shape == (4, hps.vocab_size)
+        layer = draft["decoder"]["layers"][0]
+        assert layer["cross_attn"]["wk"].shape == (hps.hidden_dim, 4)
+        assert layer["cross_attn"]["wq"].shape == (4, 4)
+        assert_spec_matches_greedy(full, draft, hps,
+                                   make_arrays(hps, 3))
+        # fresh narrow init keeps exactness too (the other init mode)
+        fresh = avg_attention.init_params(dhps, hps.vocab_size,
+                                          jax.random.PRNGKey(2))
+        assert_spec_matches_greedy(full, fresh, hps,
+                                   make_arrays(hps, 3, seed=1))
+
+    def test_narrow_draft_requires_factored_head(self):
+        with pytest.raises(ValueError, match="factored vocab head"):
+            TF_HPS.replace(draft_hidden=4).validate()
 
     def test_mapped_bootstrap_rejects_non_transformer(self):
         hps = PG_HPS
@@ -334,6 +525,29 @@ class TestServingTiers:
         assert _isolated_obs.counter("serve/tier_greedy_total").value == 3
         assert _isolated_obs.counter(
             "decode/spec_cycles_total").value > 0
+
+    def test_spec_tier_adaptive_serves_exact_rows(self, _isolated_obs):
+        """The adaptive controller through the FULL serving surface:
+        spec-tier rows stay identical to greedy-tier rows, the decoder
+        holds one persistent controller across requests, and its pick
+        is exported on the decode/spec_k_current gauge."""
+        server, decoder = self._server(_isolated_obs,
+                                       spec_k_adaptive=True, spec_k=2,
+                                       spec_k_min=1, spec_k_max=4)
+        with server:
+            greedy = [server.submit(f"the cat sat {i} .", uuid=f"g{i}",
+                                    tier="greedy").result(timeout=600)
+                      for i in range(2)]
+            spec = [server.submit(f"the cat sat {i} .", uuid=f"s{i}",
+                                  tier="spec").result(timeout=600)
+                    for i in range(2)]
+        for g, s in zip(greedy, spec):
+            assert g.decoded_words == s.decoded_words, (g.uuid, s.uuid)
+        ctl = decoder._spec_ctl
+        assert ctl is not None and ctl.cycles > 0
+        assert 1 <= ctl.k <= 4
+        assert _isolated_obs.gauge(
+            "decode/spec_k_current").value == float(ctl.k)
 
     def test_draft_tier_serves_and_counts(self, _isolated_obs):
         server, _ = self._server(_isolated_obs)

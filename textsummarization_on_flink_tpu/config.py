@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -275,6 +275,39 @@ class HParams:
     # lower FLOPs/token ratio in the spec gate (BYTE_BUDGET.json
     # "spec"), at the price of acceptance rate.
     draft_dec_layers: int = 0
+    # ---- distilled narrow draft (PERF.md "Distilled narrow draft";
+    # ISSUE 12) ----
+    # Draft decoder hidden width H_d (0 = hidden_dim, the legacy
+    # equal-width draft).  H_d < hidden_dim engages the NARROW variant:
+    # the draft still shares the full model's embedding/positions and
+    # encoder output verbatim (copied leaves), with learned
+    # down-projections at the boundaries — an [H, H_d] embedding
+    # adapter and [H, H_d] cross-attention K/V maps — so only the
+    # per-token decoder blocks shrink.  The narrow decoder has no
+    # full-model counterpart, so it must be TRAINED
+    # (train/distill.DistillTrainer); requires draft_vocab_rank > 0
+    # (the tied [V, H] projection cannot consume H_d states).
+    draft_hidden: int = 0
+    # Low-rank factored draft vocab head: scores = (h @ [H_d, r]) @
+    # [r, V] + out_bias, so the draft's projection term scales with
+    # r*V instead of H*V — the lever that moves the spec tier's FLOPs
+    # break-even from ~96% acceptance to ~50% at the committed
+    # ref-scale recipe (BYTE_BUDGET.json "spec" expected_speedup).
+    # 0 = the tied full projection (legacy).
+    draft_vocab_rank: int = 0
+    # ---- acceptance-adaptive spec_k (SERVING.md "Quality tiers";
+    # ISSUE 12) ----
+    # True: the spec tier adapts the draft length per request from the
+    # measured accept histogram (decode/speculative.SpecKController) —
+    # k starts at spec_k, moves within [spec_k_min, spec_k_max] via
+    # the expected-progress-per-FLOP model, and the adaptation happens
+    # on the HOST between draft-verify cycles, so the jitted cycle
+    # kernel compiles once per distinct k in the warm set (bounded by
+    # the range).  Output stays token-exact with full-model greedy for
+    # ANY k sequence (the verifier is unchanged).
+    spec_k_adaptive: bool = False
+    spec_k_min: int = 1
+    spec_k_max: int = 8
     # Quality tier a request gets when it names none (serve/server.py
     # submit(tier=...)): beam (full search) > greedy (beam_size=1,
     # token-exact with spec) > spec (draft-then-verify fast path) >
@@ -422,6 +455,35 @@ class HParams:
             raise ValueError(
                 f"draft_dec_layers must be in [0, dec_layers="
                 f"{self.dec_layers}], got {self.draft_dec_layers}")
+        if not 0 <= self.draft_hidden <= self.hidden_dim:
+            raise ValueError(
+                f"draft_hidden must be in [0, hidden_dim="
+                f"{self.hidden_dim}] (0 = equal width), got "
+                f"{self.draft_hidden}")
+        if self.draft_hidden and self.draft_hidden % self.num_heads != 0:
+            raise ValueError(
+                f"num_heads={self.num_heads} must divide "
+                f"draft_hidden={self.draft_hidden}")
+        if self.draft_vocab_rank < 0:
+            raise ValueError(
+                f"draft_vocab_rank must be >= 0 (0 = tied projection), "
+                f"got {self.draft_vocab_rank}")
+        if (0 < self.draft_hidden < self.hidden_dim
+                and self.draft_vocab_rank == 0):
+            raise ValueError(
+                "a narrow draft (draft_hidden < hidden_dim) requires a "
+                "factored vocab head (draft_vocab_rank > 0): the tied "
+                "[V, H] projection cannot consume H_d-wide states")
+        if self.spec_k_min < 1 or self.spec_k_max < self.spec_k_min:
+            raise ValueError(
+                f"need 1 <= spec_k_min <= spec_k_max, got "
+                f"[{self.spec_k_min}, {self.spec_k_max}]")
+        if self.spec_k_adaptive and not (
+                self.spec_k_min <= self.spec_k <= self.spec_k_max):
+            raise ValueError(
+                f"spec_k_adaptive needs the starting spec_k={self.spec_k} "
+                f"inside [spec_k_min={self.spec_k_min}, "
+                f"spec_k_max={self.spec_k_max}]")
         if self.serve_default_tier not in SERVE_TIERS:
             raise ValueError(
                 f"serve_default_tier must be one of {SERVE_TIERS}, got "
@@ -542,6 +604,27 @@ def derive_draft_hps(hps: "HParams") -> "HParams":
     return hps.replace(
         model_family="avg_attention",
         dec_layers=hps.draft_dec_layers or hps.dec_layers)
+
+
+def resolve_draft_hidden(hps: "HParams") -> int:
+    """Effective draft decoder width (draft_hidden, or hidden_dim when
+    0) — the ONE resolver, shared by models/avg_attention.py's param
+    shapes, __graft_entry__'s analytic FLOPs model, and bench's
+    fingerprint so no two components can disagree about the draft's
+    width."""
+    return hps.draft_hidden or hps.hidden_dim
+
+
+def resolve_spec_bounds(hps: "HParams") -> "Tuple[int, int, int]":
+    """(k_min, k_start, k_max) for the speculative tier.  Non-adaptive
+    jobs pin all three to spec_k; adaptive jobs get the committed
+    [spec_k_min, spec_k_max] range.  The ONE resolver — the decoder's
+    accept-histogram buckets, the SpecKController, and the adaptive
+    engine's verify-cache width all derive through here, so a metric
+    bucket can never be narrower than the k the controller may pick."""
+    if not hps.spec_k_adaptive:
+        return (hps.spec_k, hps.spec_k, hps.spec_k)
+    return (hps.spec_k_min, hps.spec_k, hps.spec_k_max)
 
 
 def parse_bucket_spec(spec: str, max_enc_steps: int) -> "List[int]":

@@ -40,6 +40,7 @@ from textsummarization_on_flink_tpu.config import (
     bucket_for,
     derive_draft_hps,
     parse_bucket_spec,
+    resolve_spec_bounds,
 )
 from textsummarization_on_flink_tpu.data import oov as oov_lib
 from textsummarization_on_flink_tpu.data.batching import Batch
@@ -178,14 +179,36 @@ class BeamSearchDecoder:
         # spec_draft="map" a checkpoint hot-swap re-derives the draft,
         # and a spec dispatch must never pair old draft with new full
         self._draft_params = draft_params
+        # accept-length histogram buckets span the FULL committed k
+        # range (0..spec_k_max via resolve_spec_bounds): under the
+        # adaptive controller, cycles run at k up to spec_k_max, and
+        # spec_k-sized buckets would pile every longer acceptance into
+        # the overflow bin (the ISSUE-12 satellite fix — same shape of
+        # fix as PR 11's serve/prefill_bucket_len)
+        _, _, spec_k_max = resolve_spec_bounds(hps)
         self._h_accept = self._obs.histogram(
             "decode/spec_accept_len",
-            buckets=[float(i) for i in range(0, hps.spec_k + 1)])
+            buckets=[float(i) for i in range(0, spec_k_max + 1)])
         self._c_spec_cycles = self._obs.counter("decode/spec_cycles_total")
         self._c_spec_drafted = self._obs.counter(
             "decode/spec_draft_tokens_total")
         self._c_spec_accepted = self._obs.counter(
             "decode/spec_accepted_tokens_total")
+        # acceptance-adaptive spec_k (ISSUE 12): ONE controller per
+        # decoder — it adapts k between cycles inside a dispatch and
+        # carries the learned acceptance estimate across requests; its
+        # current pick is exported as a gauge.  Mutated only on the
+        # dispatch path (the serve layer runs one dispatch thread).
+        self._spec_ctl = None
+        self._g_spec_k = self._obs.gauge("decode/spec_k_current")
+        # documented semantics (OBSERVABILITY.md): the gauge reads
+        # spec_k when non-adaptive, the controller's live pick otherwise
+        self._g_spec_k.set(float(hps.spec_k))
+        if getattr(hps, "spec_k_adaptive", False):
+            from textsummarization_on_flink_tpu.decode import speculative
+
+            self._spec_ctl = speculative.SpecKController.from_hps(hps)
+            self._g_spec_k.set(float(self._spec_ctl.k))
         self._params = params
         if params is None:
             self._load_params()
@@ -416,9 +439,13 @@ class BeamSearchDecoder:
                 raise ValueError(
                     "spec tier needs a draft model: set hps.spec_draft "
                     "('map'/'fresh') or pass draft_params=")
-            out = speculative.run_spec_decode(full, draft, self._hps,
-                                              batch.as_arrays())
             real = np.asarray(batch.real_mask, dtype=bool)
+            out = speculative.run_spec_decode(full, draft, self._hps,
+                                              batch.as_arrays(),
+                                              controller=self._spec_ctl,
+                                              real_mask=real)
+            if self._spec_ctl is not None:
+                self._g_spec_k.set(float(self._spec_ctl.k))
             self._c_spec_cycles.inc(int(out.cycles[real].sum()))
             self._c_spec_drafted.inc(int(out.drafted[real].sum()))
             self._c_spec_accepted.inc(int(out.accepted[real].sum()))

@@ -44,11 +44,27 @@ the accept-all case's resync state exists without a traced branch);
 after acceptance the draft state re-anchors to the stacked proposal
 state at the emitted length and the correction token becomes the next
 cycle's first input.
+
+Acceptance-adaptive spec_k (ISSUE 12): ``hps.spec_k_adaptive`` swaps
+the one-dispatch while_loop for a HOST-stepped cycle loop — one jitted
+batch dispatch per draft-verify cycle (``spec_cycle_jit``) — so the
+``SpecKController`` can re-pick k between cycles from the measured
+accept histogram via the expected-progress-per-FLOP model
+(``expected_speedup`` at the committed BYTE_BUDGET.json draft/full
+ratio).  The carry's shapes are pinned to ``spec_k_max`` (verify cache
+width, histogram rows), so each distinct k in the warm set costs
+exactly ONE compile and the warm set is bounded by the committed
+[spec_k_min, spec_k_max] range (pinned by test).  Token exactness is
+k-independent: every cycle still emits the longest draft prefix that
+matches the unchanged verifier's own greedy choices, so ANY k sequence
+reproduces full-model greedy exactly.
 """
 
 from __future__ import annotations
 
 import functools
+import json
+import os
 from typing import Any, Dict, NamedTuple
 
 import jax
@@ -95,7 +111,8 @@ class _SpecCarry(NamedTuple):
     d_state: Any  # draft-model adapter state (K=1 leaves)
     cycles: Array  # scalar int32
     accepted: Array  # scalar int32
-    hist: Array  # [spec_k+1] int32
+    hist: Array  # [k_cap+1] int32 (k_cap = spec_k, or spec_k_max adaptive)
+    drafted: Array  # scalar int32: draft tokens proposed (sum of per-cycle k)
 
 
 def _greedy_choice(topk_ids: Array, topk_lps: Array, t: Array,
@@ -117,21 +134,25 @@ def _map_unk(tokens: Array, vocab_size: int) -> Array:
 
 
 def _make_full_driver(params, hps: HParams, spec_k: int, enc_one,
-                      enc_mask, ext_ids):
+                      enc_mask, ext_ids, cache_k: int = None):
     """(init_state, verify, commit) for the FULL model.
 
     verify(state, t0, inputs[S]) -> (choices [S], lps [S],
     attn [S, T_enc], pgen [S], aux); commit(aux, a) -> the state
     consistent with the prefix extended by the first a+1 inputs.
+    ``cache_k`` sizes the verify cache independently of the cycle's
+    spec_k (the adaptive engine pins it to spec_k_max so every k in
+    the warm set shares ONE carry shape); None = spec_k.
     """
     S = spec_k + 1
+    cache_k = spec_k if cache_k is None else cache_k
     choose = jax.vmap(_greedy_choice, in_axes=(0, 0, 0, None))
 
     if hps.model_family == "transformer":
         family = get_family(hps.model_family)
 
         def init_state():
-            return family.spec_init_state(hps, spec_k)
+            return family.spec_init_state(hps, cache_k)
 
         def verify(state, t0, inputs):
             tids, tlps, attn, pgen, new_state = family.spec_verify(
@@ -238,9 +259,75 @@ def _spec_body(draft_params, fhps: HParams, spec_k: int, d_enc_one,
             cycles=c.cycles + 1,
             accepted=c.accepted + a,
             hist=c.hist.at[a].add(1),
+            drafted=c.drafted + spec_k,
         )
 
     return body
+
+
+def _article_fns(full_params, draft_params, fhps: HParams, dhps: HParams,
+                 spec_k: int, k_cap: int):
+    """(init_one, cycle_one) closures for ONE article — the shared
+    engine core: the one-dispatch while_loop path composes them inside
+    one trace, the adaptive path dispatches cycle_one per host cycle.
+    ``k_cap`` pins the carry's k-dependent shapes (verify cache width,
+    histogram rows) so cycles at different k share one carry."""
+    T = fhps.max_dec_steps
+
+    def init_one(f_enc_one, d_enc_one, enc_mask, ext_ids) -> _SpecCarry:
+        T_enc = enc_mask.shape[0]
+        f_init, _, _ = _make_full_driver(
+            full_params, fhps, spec_k, f_enc_one, enc_mask, ext_ids,
+            cache_k=k_cap)
+        d_init_fn, _ = get_family(dhps.model_family).beam_adapter(dhps)
+        return _SpecCarry(
+            t=jnp.zeros((), jnp.int32),
+            last=jnp.asarray(START_ID, jnp.int32),
+            done=jnp.zeros((), jnp.bool_),
+            sum_lp=jnp.zeros((), jnp.float32),
+            tokens=jnp.zeros((T + 1,), jnp.int32),
+            attn=jnp.zeros((T + 1, T_enc), jnp.float32),
+            pgens=jnp.zeros((T + 1,), jnp.float32),
+            f_state=f_init(),
+            d_state=d_init_fn(draft_params, d_enc_one),
+            cycles=jnp.zeros((), jnp.int32),
+            accepted=jnp.zeros((), jnp.int32),
+            hist=jnp.zeros((k_cap + 1,), jnp.int32),
+            drafted=jnp.zeros((), jnp.int32),
+        )
+
+    def cycle_one(f_enc_one, d_enc_one, enc_mask, ext_ids,
+                  c: _SpecCarry) -> _SpecCarry:
+        _, verify, commit = _make_full_driver(
+            full_params, fhps, spec_k, f_enc_one, enc_mask, ext_ids,
+            cache_k=k_cap)
+        _, d_step = get_family(dhps.model_family).beam_adapter(dhps)
+        body = _spec_body(draft_params, fhps, spec_k, d_enc_one, enc_mask,
+                          ext_ids, verify, commit, d_step)
+        return body(c)
+
+    return init_one, cycle_one
+
+
+def _out_of_carry(c: _SpecCarry, T: int) -> SpecDecodeOutput:
+    """Finalize one article's carry (batch-axis-agnostic: the slices
+    below broadcast over a leading batch axis, so both the vmapped
+    one-dispatch path and the adaptive host loop share it)."""
+    length = c.t + 1  # generated tokens + START (the beam length rule)
+    start = jnp.broadcast_to(jnp.asarray(START_ID, jnp.int32),
+                             c.t.shape + (1,)) if c.t.ndim \
+        else jnp.array([START_ID], jnp.int32)
+    return SpecDecodeOutput(
+        tokens=jnp.concatenate([start, c.tokens[..., :T]], axis=-1),
+        length=length,
+        avg_log_prob=c.sum_lp / length.astype(jnp.float32),
+        attn_dists=c.attn[..., :T, :],
+        p_gens=c.pgens[..., :T],
+        cycles=c.cycles,
+        drafted=c.drafted,
+        accepted=c.accepted,
+        accept_hist=c.hist,
+    )
 
 
 def _spec_one(full_params, draft_params, fhps: HParams, dhps: HParams,
@@ -249,41 +336,14 @@ def _spec_one(full_params, draft_params, fhps: HParams, dhps: HParams,
     fhps/dhps arrive with beam_size=1 — run_spec_decode, the one host
     entry, normalizes them so the jit cache key cannot fragment over a
     beam width the engine ignores."""
-    T = fhps.max_dec_steps
-    T_enc = enc_mask.shape[0]
-    f_init, verify, commit = _make_full_driver(
-        full_params, fhps, spec_k, f_enc_one, enc_mask, ext_ids)
-    d_init_fn, d_step = get_family(dhps.model_family).beam_adapter(dhps)
-    body = _spec_body(draft_params, fhps, spec_k, d_enc_one, enc_mask,
-                      ext_ids, verify, commit, d_step)
-    init = _SpecCarry(
-        t=jnp.zeros((), jnp.int32),
-        last=jnp.asarray(START_ID, jnp.int32),
-        done=jnp.zeros((), jnp.bool_),
-        sum_lp=jnp.zeros((), jnp.float32),
-        tokens=jnp.zeros((T + 1,), jnp.int32),
-        attn=jnp.zeros((T + 1, T_enc), jnp.float32),
-        pgens=jnp.zeros((T + 1,), jnp.float32),
-        f_state=f_init(),
-        d_state=d_init_fn(draft_params, d_enc_one),
-        cycles=jnp.zeros((), jnp.int32),
-        accepted=jnp.zeros((), jnp.int32),
-        hist=jnp.zeros((spec_k + 1,), jnp.int32),
-    )
-    c = jax.lax.while_loop(lambda s: jnp.logical_not(s.done), body, init)
-    length = c.t + 1  # generated tokens + START (the beam length rule)
-    return SpecDecodeOutput(
-        tokens=jnp.concatenate([jnp.array([START_ID], jnp.int32),
-                                c.tokens[:T]]),
-        length=length,
-        avg_log_prob=c.sum_lp / length.astype(jnp.float32),
-        attn_dists=c.attn[:T],
-        p_gens=c.pgens[:T],
-        cycles=c.cycles,
-        drafted=c.cycles * spec_k,
-        accepted=c.accepted,
-        accept_hist=c.hist,
-    )
+    init_one, cycle_one = _article_fns(full_params, draft_params, fhps,
+                                       dhps, spec_k, spec_k)
+    init = init_one(f_enc_one, d_enc_one, enc_mask, ext_ids)
+    c = jax.lax.while_loop(
+        lambda s: jnp.logical_not(s.done),
+        lambda s: cycle_one(f_enc_one, d_enc_one, enc_mask, ext_ids, s),
+        init)
+    return _out_of_carry(c, fhps.max_dec_steps)
 
 
 @functools.partial(jax.jit, static_argnames=("fhps", "dhps", "spec_k"))
@@ -308,10 +368,26 @@ def run_spec_decode_jit(full_params, draft_params, fhps: HParams,
 
 
 def run_spec_decode(full_params, draft_params, hps: HParams,
-                    arrays: Dict[str, np.ndarray]) -> SpecDecodeOutput:
+                    arrays: Dict[str, np.ndarray],
+                    controller: "SpecKController" = None,
+                    real_mask=None) -> SpecDecodeOutput:
     """Host entry: resolve the draft shape (config.derive_draft_hps),
     dispatch once, return host numpy (run_beam_search's contract, plus
-    the speculative stats)."""
+    the speculative stats).
+
+    ``controller`` (or ``hps.spec_k_adaptive``) routes through the
+    acceptance-adaptive engine instead: one dispatch per draft-verify
+    cycle, k re-picked on the host between cycles — same output
+    contract, same token exactness (pass a persistent controller to
+    carry the learned acceptance estimate across batches, the
+    decoder's pattern; ``real_mask`` keeps padding rows out of its
+    observations)."""
+    if controller is None and getattr(hps, "spec_k_adaptive", False):
+        controller = SpecKController.from_hps(hps)
+    if controller is not None:
+        return run_spec_decode_adaptive(full_params, draft_params, hps,
+                                        arrays, controller,
+                                        real_mask=real_mask)
     fhps = hps.replace(beam_size=1)  # the verify path is single-hyp
     dhps = derive_draft_hps(hps).replace(beam_size=1, mode="decode")
     enc_arrays = {k: v for k, v in arrays.items() if k.startswith("enc_")}
@@ -331,6 +407,211 @@ def run_spec_decode(full_params, draft_params, hps: HParams,
                 else "decode/compile_cache_hits_total").inc()
         except Exception:  # tslint: disable=TS005 — best-effort cache-hit telemetry; decode result already in hand
             pass
+    return SpecDecodeOutput(*[np.asarray(x) for x in out])
+
+
+# --------------------------------------------------------------------------
+# Acceptance-adaptive spec_k (ISSUE 12)
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("fhps", "dhps", "k_cap"))
+def spec_prepare_jit(full_params, draft_params, fhps: HParams,
+                     dhps: HParams, arrays: Dict[str, Array], k_cap: int):
+    """Encode the batch with both models and build the initial carry
+    for the adaptive engine — ONE compile per (shapes, k_cap), shared
+    by every k the controller later picks (the carry's k-dependent
+    shapes ride k_cap, not the cycle's k)."""
+    f_enc = get_family(fhps.model_family).beam_encode(full_params, fhps,
+                                                      arrays)
+    d_enc = get_family(dhps.model_family).beam_encode(draft_params, dhps,
+                                                      arrays)
+    init_one, _ = _article_fns(full_params, draft_params, fhps, dhps,
+                               k_cap, k_cap)
+    carry = jax.vmap(init_one)(f_enc, d_enc, arrays["enc_padding_mask"],
+                               arrays["enc_batch_extend_vocab"])
+    return f_enc, d_enc, carry
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("fhps", "dhps", "spec_k", "k_cap"))
+def spec_cycle_jit(full_params, draft_params, fhps: HParams, dhps: HParams,
+                   f_enc, d_enc, enc_mask, ext_ids, carry, spec_k: int,
+                   k_cap: int):
+    """One draft-verify-commit cycle at ``spec_k`` for the whole batch
+    (done articles pass through untouched).  One compile per DISTINCT
+    spec_k — the warm set the controller walks is bounded by the
+    committed [spec_k_min, spec_k_max] range (pinned by test)."""
+    _, cycle_one = _article_fns(full_params, draft_params, fhps, dhps,
+                                spec_k, k_cap)
+
+    def one(f1, d1, m, x, c):
+        return jax.lax.cond(
+            c.done, lambda cc: cc,
+            lambda cc: cycle_one(f1, d1, m, x, cc), c)
+
+    return jax.vmap(one)(f_enc, d_enc, enc_mask, ext_ids, carry)
+
+
+#: committed draft/full per-step cost ratios, read once per process
+_RATIO_CACHE: Dict[str, float] = {}
+
+
+def committed_draft_ratio(family: str, default: float = 0.5) -> float:
+    """The committed draft/full per-step cost ratio the adaptive
+    controller's progress-per-FLOP model prices draft steps at —
+    BYTE_BUDGET.json spec.max_draft_flops_ratio (a CEILING, so the
+    controller is conservative about how cheap drafting is).  Falls
+    back to ``default`` when the budget file is absent (installed
+    packages, stripped checkouts)."""
+    if family not in _RATIO_CACHE:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "BYTE_BUDGET.json")
+        try:
+            with open(path, encoding="utf-8") as f:
+                ratio = float(
+                    json.load(f)["spec"]["max_draft_flops_ratio"][family])
+        except (OSError, KeyError, TypeError, ValueError):
+            ratio = float(default)
+        _RATIO_CACHE[family] = ratio
+    return _RATIO_CACHE[family]
+
+
+class SpecKController:
+    """Acceptance-adaptive draft length (ISSUE 12): start at k_start,
+    track the measured accept histogram, and pick the k in
+    [k_min, k_max] that maximizes expected progress per FLOP —
+    ``expected_speedup(alpha, k, draft_ratio)``, the same
+    bandwidth-model formula the BYTE_BUDGET.json spec gate pins.
+
+    Pure host arithmetic on cumulative counts: the k trajectory is a
+    DETERMINISTIC function of the observed accept sequence (pinned by
+    test) — no wall clock, no RNG.  The per-position acceptance
+    probability alpha is estimated from the histogram the verifier
+    already emits: a cycle with accept length a < k is a successes and
+    one failure (the rejection), a == k is k censored successes; a
+    small symmetric prior keeps the first cycles from slamming k to a
+    bound on one observation.
+    """
+
+    def __init__(self, k_min: int, k_start: int, k_max: int,
+                 draft_ratio: float, prior_trials: float = 8.0,
+                 prior_alpha: float = 0.5):
+        if not 1 <= k_min <= k_start <= k_max:
+            raise ValueError(
+                f"need 1 <= k_min <= k_start <= k_max, got "
+                f"[{k_min}, {k_start}, {k_max}]")
+        if draft_ratio <= 0:
+            raise ValueError(f"draft_ratio must be > 0, got {draft_ratio}")
+        self.k = int(k_start)
+        self.k_min = int(k_min)
+        self.k_max = int(k_max)
+        self.draft_ratio = float(draft_ratio)
+        self._succ = float(prior_alpha) * float(prior_trials)
+        self._trials = float(prior_trials)
+        self.cycles = 0
+        self.drafted = 0
+        self.accepted = 0
+
+    @classmethod
+    def from_hps(cls, hps: HParams,
+                 draft_ratio: float = None) -> "SpecKController":
+        """The ONE construction path for configured jobs: bounds from
+        config.resolve_spec_bounds, cost ratio from the committed
+        budget (unless injected — tests pin trajectories with explicit
+        ratios)."""
+        from textsummarization_on_flink_tpu.config import resolve_spec_bounds
+
+        k_min, k_start, k_max = resolve_spec_bounds(hps)
+        if draft_ratio is None:
+            draft_ratio = committed_draft_ratio(hps.model_family)
+        return cls(k_min, k_start, k_max, draft_ratio)
+
+    @property
+    def alpha(self) -> float:
+        """Current per-position acceptance-probability estimate."""
+        return self._succ / self._trials
+
+    @property
+    def mean_k(self) -> float:
+        """Realized mean spec_k over observed cycles (k_start before
+        any observation)."""
+        return self.drafted / self.cycles if self.cycles else float(self.k)
+
+    def observe(self, hist_counts, k_used: int) -> int:
+        """Fold one cycle batch's accept-histogram DELTA (counts per
+        accept length 0..k_used, padded rows past k_used ignored) into
+        the estimate and re-pick k.  Returns the new k."""
+        k_used = int(k_used)
+        counts = [int(x) for x in hist_counts]
+        for a, n in enumerate(counts[:k_used + 1]):
+            if n <= 0:
+                continue
+            self.cycles += n
+            self.drafted += n * k_used
+            self.accepted += n * a
+            self._succ += n * a
+            self._trials += n * (a + 1 if a < k_used else a)
+        return self.update()
+
+    def update(self) -> int:
+        """Re-pick k = argmax expected progress per FLOP at the current
+        alpha (ties break LOW — never pay extra draft steps for equal
+        expected progress)."""
+        alpha = self.alpha
+        best_k, best = self.k_min, -1.0
+        for k in range(self.k_min, self.k_max + 1):
+            s = expected_speedup(alpha, k, self.draft_ratio)
+            if s > best + 1e-12:
+                best, best_k = s, k
+        self.k = best_k
+        return self.k
+
+
+def run_spec_decode_adaptive(full_params, draft_params, hps: HParams,
+                             arrays: Dict[str, np.ndarray],
+                             controller: SpecKController,
+                             real_mask=None) -> SpecDecodeOutput:
+    """The acceptance-adaptive host loop (ISSUE 12): prepare once, then
+    one ``spec_cycle_jit`` dispatch per draft-verify cycle, with the
+    controller re-picking k from the accept-histogram delta between
+    cycles.  The per-cycle host sync IS the adaptivity price (stated in
+    PERF.md); everything inside a cycle stays one fused dispatch, and
+    the compile warm set is one entry per distinct k.
+
+    ``real_mask`` [B] (bool) restricts the controller's observations to
+    real batch rows — padding repeats (batching.py real_mask semantics)
+    decode too, but must not multiply-count one article's acceptance
+    into the estimate the k policy runs on (the same real-rows rule the
+    decoder applies to the decode/spec_* counters)."""
+    fhps = hps.replace(beam_size=1)  # the verify path is single-hyp
+    dhps = derive_draft_hps(hps).replace(beam_size=1, mode="decode")
+    k_cap = controller.k_max
+    enc_arrays = {k: v for k, v in arrays.items() if k.startswith("enc_")}
+    f_enc, d_enc, carry = spec_prepare_jit(full_params, draft_params, fhps,
+                                           dhps, enc_arrays, k_cap)
+    enc_mask = jnp.asarray(enc_arrays["enc_padding_mask"])
+    ext_ids = jnp.asarray(enc_arrays["enc_batch_extend_vocab"])
+    real = (np.asarray(real_mask, dtype=bool) if real_mask is not None
+            else np.ones(enc_arrays["enc_batch"].shape[0], dtype=bool))
+    prev_hist = 0  # broadcasts against the first fetched histogram
+    # every cycle commits >= 1 token per live article, so max_dec_steps
+    # cycles is a hard completion bound (not a tunable)
+    k_cap = int(k_cap)
+    for _ in range(fhps.max_dec_steps):
+        k = controller.k  # host int by construction (SpecKController)
+        carry = spec_cycle_jit(full_params, draft_params, fhps, dhps,
+                               f_enc, d_enc, enc_mask, ext_ids, carry,
+                               k, k_cap)
+        # the sanctioned between-cycle sync: ONE D2H fetch hands the
+        # controller this cycle's accept histogram and the done flags
+        # together (module docstring)
+        hist, done = jax.device_get((carry.hist, carry.done))  # tslint: disable=TS002 — the adaptive contract's one per-cycle D2H read
+        controller.observe((hist - prev_hist)[real].sum(axis=0), k)
+        prev_hist = hist
+        if done.all():
+            break
+    out = _out_of_carry(carry, fhps.max_dec_steps)
     return SpecDecodeOutput(*[np.asarray(x) for x in out])
 
 

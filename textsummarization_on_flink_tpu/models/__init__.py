@@ -12,6 +12,13 @@ family-agnostic:
 Select with ``hps.model_family`` (the reference has a single hardcoded
 model, run_summarization.py:376; the family seam is a rebuild addition
 that the BASELINE.md stretch config requires).
+
+The third family, ``avg_attention``, is the speculative tier's draft
+(O(1)-in-history decode state); it honors two extra HParams the other
+families ignore — ``draft_hidden`` (narrow decoder behind boundary
+projections) and ``draft_vocab_rank`` (factored vocab head) — while
+keeping this exact functional surface, so every consumer listed above
+works on the narrow variant unmodified (ISSUE 12).
 """
 
 from __future__ import annotations

@@ -378,18 +378,55 @@ def forward_train(params: Params, hps: HParams, arrays: Dict[str, Array],
     return train_output_tail(params, hps, arrays, h, cross_ctx, attn_dist)
 
 
+def vocab_scores_of(params: Params, hps: HParams, h: Array) -> Array:
+    """Raw vocabulary scores for final-LN decoder states ``h``
+    [..., H_dec]: the tied-embedding projection, or — when the family
+    carries a factored low-rank head (the distilled narrow draft,
+    ISSUE 12) — ``(h @ w1) @ w2`` with w1 [H_d, r], w2 [r, V], never
+    materializing the [H_d, V] product.  ONE source: the train loss
+    head and every decode output tail route the projection through
+    here, so the two heads cannot drift.  Both factored matmuls route
+    through the ONE dtype-aware projection (ops/losses.project_scores,
+    bf16 operands + f32 accumulation under compute_dtype=bfloat16) —
+    same kernel as the tied branch and the streaming chunk bodies."""
+    vh = params.get("vocab_head")
+    if vh is not None:
+        hr = loss_ops.project_scores(h, vh["w1"], hps.compute_dtype)
+        return loss_ops.project_scores(hr, vh["w2"], hps.compute_dtype) \
+            + params["out_bias"]
+    return pg._proj(hps, h, params["embedding"].T) + params["out_bias"]
+
+
+def vocab_proj_weight(params: Params) -> Array:
+    """[H_dec, V] dense projection matrix for the STREAMING loss
+    kernels (ops/losses), which consume one weight matrix: the tied
+    embedding transpose, or the materialized w1 @ w2 of the factored
+    head (parameter-sized — r*V*H_d FLOPs once per step, amortized
+    over B*T_dec positions).  Factored-head caveat: the streaming path
+    projects h @ (w1 @ w2) while ``vocab_scores_of`` computes
+    (h @ w1) @ w2, so loss_chunk on/off agree to matmul-association
+    tolerance for factored heads, not bitwise (the tied head stays
+    exact — identical W, identical kernel)."""
+    vh = params.get("vocab_head")
+    if vh is not None:
+        return vh["w1"] @ vh["w2"]
+    return params["embedding"].T
+
+
 def train_output_tail(params: Params, hps: HParams, arrays: Dict[str, Array],
                       h: Array, cross_ctx: Array, attn_dist: Array,
                       ) -> TrainOutput:
     """The loss head shared by every transformer-shaped decoder family
-    (transformer, avg_attention): p_gen from [h, cross_ctx], tied vocab
-    projection (streamed when --loss_chunk, materialized otherwise),
-    pointer mixture or baseline CE, coverage penalty.  ONE source for the
-    mixture math keeps the families' losses from drifting.
+    (transformer, avg_attention — including the factored-head narrow
+    draft): p_gen from [h, cross_ctx], vocab projection via
+    ``vocab_scores_of`` (streamed when --loss_chunk, materialized
+    otherwise), pointer mixture or baseline CE, coverage penalty.  ONE
+    source for the mixture math keeps the families' losses from
+    drifting.
 
-    h: [B, T_dec, H] final-LN decoder states (f32); cross_ctx: final
-    layer's cross-attention output; attn_dist: its head-averaged copy
-    distribution [B, T_dec, T_enc].
+    h: [B, T_dec, H_dec] final-LN decoder states (f32); cross_ctx:
+    final layer's cross-attention output; attn_dist: its head-averaged
+    copy distribution [B, T_dec, T_enc].
     """
     dec_mask = arrays["dec_padding_mask"]  # [B, T_dec]
 
@@ -411,18 +448,17 @@ def train_output_tail(params: Params, hps: HParams, arrays: Dict[str, Array],
                 h_t, jnp.swapaxes(attn_dist, 0, 1),
                 jnp.swapaxes(p_gens, 0, 1), targets_t,
                 arrays["enc_batch_extend_vocab"],
-                params["embedding"].T, params["out_bias"],
+                vocab_proj_weight(params), params["out_bias"],
                 chunk=hps.loss_chunk, compute_dtype=hps.compute_dtype)
             gold = jnp.swapaxes(gold_t, 0, 1)
             loss = loss_ops.mask_and_avg(-jnp.log(gold + 1e-10), dec_mask)
         else:
             loss = loss_ops.streaming_softmax_cross_entropy(
                 h_t, targets_t, jnp.swapaxes(dec_mask, 0, 1),
-                params["embedding"].T, params["out_bias"],
+                vocab_proj_weight(params), params["out_bias"],
                 chunk=hps.loss_chunk, compute_dtype=hps.compute_dtype)
     else:
-        logits = (pg._proj(hps, h, params["embedding"].T)
-                  + params["out_bias"])  # [B, T_dec, V] tied projection
+        logits = vocab_scores_of(params, hps, h)  # [B, T_dec, V]
         if hps.pointer_gen:
             # gold prob without materializing the [B, T, V] softmax —
             # the SAME mixture math as the pg family and the streaming
@@ -449,9 +485,16 @@ def train_output_tail(params: Params, hps: HParams, arrays: Dict[str, Array],
 # --------------------------------------------------------------------------
 
 def beam_encode(params: Params, hps: HParams, arrays: Dict[str, Array],
-                ) -> TransformerEncView:
+                head_hps: Optional[HParams] = None) -> TransformerEncView:
     """Encode a batch once and precompute per-layer cross-attention K/V
-    (leaves have a leading batch axis; vmapped per-article downstream)."""
+    (leaves have a leading batch axis; vmapped per-article downstream).
+
+    ``head_hps`` carries the DECODER-side width for the head split (the
+    narrow AAN draft's H_d — its rectangular [H, H_d] K/V kernels make
+    this precompute the encoder-view boundary projection, ISSUE 12);
+    None = hps (the transformer itself).  ONE body for both families —
+    a numerics change here reaches every encoder view."""
+    head_hps = head_hps if head_hps is not None else hps
     x = _embed_enc(params, hps, arrays["enc_batch"])
     enc_out = _encoder_stack(params, hps, x, arrays["enc_padding_mask"])
     enc_c = pg._cast(hps, enc_out)
@@ -459,8 +502,8 @@ def beam_encode(params: Params, hps: HParams, arrays: Dict[str, Array],
     ks, vs = [], []
     for layer in params["decoder"]["layers"]:
         p = layer["cross_attn"]
-        ks.append(_split_heads(hps, enc_c @ p["wk"].astype(dt)))
-        vs.append(_split_heads(hps, enc_c @ p["wv"].astype(dt)))
+        ks.append(_split_heads(head_hps, enc_c @ p["wk"].astype(dt)))
+        vs.append(_split_heads(head_hps, enc_c @ p["wv"].astype(dt)))
     return TransformerEncView(cross_k=jnp.stack(ks, axis=1),
                               cross_v=jnp.stack(vs, axis=1))
 
@@ -541,11 +584,11 @@ def decode_output_tail(params: Params, hps: HParams, y: Array,
                        ) -> Tuple[Array, Array, Array]:
     """Decoder output head shared by every transformer-shaped decode
     path (beam adapter step, ``spec_verify``, the AAN step): final LN,
-    tied vocab projection, p_gen, pointer mixture.  Returns
-    (final_dist [R, V_ext], p_gen [R], h [R, H] f32)."""
+    vocab projection via ``vocab_scores_of`` (tied, or the narrow
+    draft's factored head), p_gen, pointer mixture.  Returns
+    (final_dist [R, V_ext], p_gen [R], h [R, H_dec] f32)."""
     h = _ln(params["decoder"]["ln_out"], y).astype(jnp.float32)
-    vocab_scores = pg._proj(hps, h, params["embedding"].T) \
-        + params["out_bias"]
+    vocab_scores = vocab_scores_of(params, hps, h)
     vocab_dist = jax.nn.softmax(vocab_scores, axis=-1)
     p_gen = jax.nn.sigmoid(
         jnp.concatenate([h, cross_ctx.astype(jnp.float32)], axis=-1)

@@ -58,6 +58,15 @@ Contracts (both modes):
     through the queue with blocking-submit backpressure — the
     concurrency upgrade for ``pipeline/app.py:start_inference``.
 
+Speculative tier (SERVING.md "Quality tiers"): spec-tier sub-batches
+dispatch through the decoder's draft-then-verify engine; with
+``hps.spec_k_adaptive`` the decoder's ONE SpecKController adapts the
+draft length between cycles inside each dispatch and carries its
+learned acceptance estimate across requests — this dispatch loop is
+single-threaded, which is what makes the controller's unlocked
+mutation safe (decode/speculative.py; the current pick is on the
+``decode/spec_k_current`` gauge).
+
 Observability (SERVING.md): serve/queue_depth, serve/time_in_queue_
 seconds, serve/batch_fill, serve/e2e_latency_seconds, serve/shed_total,
 serve/degraded_total, serve/errors_total, and the per-tier family

@@ -1,1 +1,4 @@
-"""Training: optimizer, jitted/pjitted train step, loops, eval."""
+"""Training: optimizer, jitted/pjitted train step, loops, eval, and
+sequence-level draft distillation (train/distill.py — the narrow
+speculative draft trained from the frozen full model through the same
+loss head and step body as from-scratch training)."""

@@ -1,0 +1,281 @@
+"""Sequence-level distillation of the narrow AAN draft (ISSUE 12;
+PERF.md "Distilled narrow draft").
+
+The narrow draft (``draft_hidden`` < hidden_dim + factored vocab head,
+models/avg_attention.py) is what makes speculation pay on FLOPs — but
+its decoder has no full-model counterpart to map from, so it must be
+TRAINED.  This module trains it to imitate the FROZEN full model:
+
+  * the teacher decodes each batch ONCE through the existing greedy
+    tier (``beam_size=1`` beam search — bitwise the program the serving
+    ladder's greedy tier and the spec verifier's acceptance test run),
+  * the teacher's emitted stream becomes the teacher-forced
+    (dec_batch, target_batch, dec_padding_mask) triple — extended-vocab
+    ids stay in the TARGETS (the pointer mixture scores them against
+    the article) and feed back UNK-mapped as inputs, the decoder's own
+    feed-back rule,
+  * the draft trains on that triple through the SHARED
+    ``transformer.train_output_tail`` loss head with the standard
+    clip -> Adagrad step body (``trainer.make_train_step``), so the
+    distillation objective and the from-scratch objective are one code
+    path.
+
+This is sequence-level distillation in the Kim & Rush sense: the
+student fits the teacher's MODE (its greedy output) — exactly the
+sequence the spec verifier compares proposals against — so the loss
+directly optimizes the acceptance rate the BYTE_BUDGET.json spec gate
+pins (held-out floor enforced in tier-1).
+
+Checkpointing: the draft's TrainState rides the standard
+``checkpoint.Checkpointer`` format in its own directory, PLUS a
+``teacher.json`` sidecar carrying a content fingerprint of the frozen
+teacher — ``restore()`` refuses a draft checkpoint whose teacher does
+not match the one in hand, so the (full, draft) pair can never be
+silently mismatched across a save/restore cycle.  At serve time the
+distilled draft injects via ``BeamSearchDecoder(draft_params=...)``
+(``load_distilled_draft``); mapped (``spec_draft="map"``) drafts keep
+re-deriving on checkpoint hot-swap under the decoder's params lock.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.config import HParams, derive_draft_hps
+from textsummarization_on_flink_tpu.data.vocab import START_ID, UNK_ID
+from textsummarization_on_flink_tpu.train import trainer as trainer_lib
+
+log = logging.getLogger(__name__)
+
+TEACHER_SIDECAR = "teacher.json"
+
+
+def teacher_fingerprint(full_params: Any) -> str:
+    """Content fingerprint of the frozen teacher: sha256 over every
+    leaf's bytes in deterministic (flattened-name) order.  Cheap at
+    any committed scale (one pass over ~100 MB) and exact — two
+    teachers collide only if they are byte-identical."""
+    from textsummarization_on_flink_tpu.checkpoint import checkpointer as ck
+
+    flat = ck._flatten(jax.device_get(full_params))
+    h = hashlib.sha256()
+    for name in sorted(flat):
+        h.update(name.encode("utf-8"))
+        h.update(np.ascontiguousarray(flat[name]).tobytes())
+    return h.hexdigest()[:16]
+
+
+def teacher_arrays(full_params: Any, hps: HParams,
+                   arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """One batch's distillation triple: greedy-decode the articles with
+    the frozen teacher (the greedy tier's exact program) and lay the
+    emitted stream out teacher-forced.  Targets keep extended-vocab
+    ids (the pointer loss scores copies); inputs are the targets
+    shifted right behind START and UNK-mapped (the feed-back rule)."""
+    from textsummarization_on_flink_tpu.decode import beam_search
+
+    thps = hps.replace(beam_size=1, mode="decode")
+    enc = {k: v for k, v in arrays.items() if k.startswith("enc_")}
+    out = beam_search.run_beam_search(full_params, thps, enc)
+    B = enc["enc_batch"].shape[0]
+    T = hps.max_dec_steps
+    dec = np.zeros((B, T), np.int32)
+    tgt = np.zeros((B, T), np.int32)
+    mask = np.zeros((B, T), np.float32)
+    tokens = np.asarray(out.tokens)
+    lengths = np.asarray(out.length)
+    for b in range(B):
+        n = min(int(lengths[b]) - 1, T)  # generated tokens (past START)
+        if n <= 0:
+            continue
+        gen = tokens[b, 1:1 + n].astype(np.int64)
+        inputs = np.concatenate(([START_ID], gen[:n - 1]))
+        dec[b, :n] = np.where(inputs >= hps.vocab_size, UNK_ID, inputs)
+        tgt[b, :n] = gen
+        mask[b, :n] = 1.0
+    return {**enc, "dec_batch": dec, "target_batch": tgt,
+            "dec_padding_mask": mask}
+
+
+def acceptance_rate(full_params: Any, draft_params: Any, hps: HParams,
+                    arrays: Dict[str, np.ndarray]) -> float:
+    """Measured accept fraction (accepted / drafted) of one spec-decode
+    pass — the distillation quality number the BYTE_BUDGET.json spec
+    gate floors on its held-out synthetic set."""
+    from textsummarization_on_flink_tpu.decode import speculative
+
+    out = speculative.run_spec_decode(full_params, draft_params, hps,
+                                      arrays)
+    drafted = int(out.drafted.sum())
+    return int(out.accepted.sum()) / drafted if drafted else 0.0
+
+
+def load_distilled_draft(train_dir: str,
+                         full_params: Optional[Any] = None) -> Any:
+    """Draft params from the newest checkpoint in a DistillTrainer
+    directory, verifying the teacher sidecar against ``full_params``
+    when given — the serve-side loader for
+    ``BeamSearchDecoder(draft_params=...)``."""
+    from textsummarization_on_flink_tpu.checkpoint import checkpointer as ck
+
+    path, flat = ck.load_ckpt(train_dir, max_retries=0)
+    state = ck.arrays_to_state(flat)
+    if full_params is not None:
+        _check_teacher(train_dir, teacher_fingerprint(full_params), path)
+    return state.params
+
+
+def _check_teacher(train_dir: str, fingerprint: str, ckpt_path: str) -> None:
+    sidecar = os.path.join(train_dir, TEACHER_SIDECAR)
+    try:
+        with open(sidecar, encoding="utf-8") as f:
+            want = json.load(f)["teacher_sha"]
+    except (OSError, KeyError, ValueError):
+        return  # pre-sidecar dir: nothing to verify against
+    if want != fingerprint:
+        raise ValueError(
+            f"distilled draft at {ckpt_path} was trained against teacher "
+            f"{want}, not the full model in hand ({fingerprint}) — a "
+            f"mismatched (full, draft) pair silently tanks acceptance; "
+            f"re-distill or load the matching teacher checkpoint")
+
+
+class DistillTrainer:
+    """Single-host distillation driver for the narrow draft.
+
+    ``hps`` is the FULL model's config (the draft shape derives through
+    ``config.derive_draft_hps`` — the one resolver, so the trained
+    draft is exactly the shape the decoder will build); ``batcher`` is
+    any ``next_batch() -> Batch | None`` source of ARTICLES (the
+    abstracts are ignored — the teacher writes the targets).
+
+    ``cache_teacher=True`` memoizes the teacher triple per batch
+    OBJECT — the epoch-over-a-fixed-set recipe (tests, smokes): the
+    teacher decodes each batch once, later epochs pay only the draft
+    step.
+    """
+
+    def __init__(self, hps: HParams, vsize: int, batcher: Any,
+                 full_params: Any,
+                 state: Optional[trainer_lib.TrainState] = None,
+                 checkpointer: Optional[Any] = None,
+                 checkpoint_secs: float = 60.0,
+                 metrics_every: int = 0,
+                 cache_teacher: bool = False,
+                 seed: Optional[int] = None):
+        self.hps = hps
+        self.dhps = derive_draft_hps(hps).replace(mode="train")
+        self.batcher = batcher
+        self.full_params = full_params
+        self.checkpointer = checkpointer
+        self.checkpoint_secs = checkpoint_secs
+        self.metrics_every = (metrics_every
+                              or getattr(hps, "metrics_every", 0) or 10)
+        self._teacher_sha = teacher_fingerprint(full_params)
+        restored = None
+        if state is None and checkpointer is not None:
+            restored = checkpointer.restore()
+            if restored is not None:
+                _check_teacher(checkpointer.directory, self._teacher_sha,
+                               "restored checkpoint")
+                restored = trainer_lib.cast_opt_state(self.dhps, restored)
+        if state is not None:
+            self.state = state
+        elif restored is not None:
+            self.state = restored
+        else:
+            self.state = trainer_lib.init_train_state(
+                self.dhps, vsize,
+                seed=seed if seed is not None else hps.seed)
+        # the shared step BODY (clip -> Adagrad) over the draft family's
+        # forward through the shared loss head — ONE objective code path
+        # with from-scratch training (trainer.make_grad_fn(dhps))
+        self._step_fn = jax.jit(trainer_lib.make_train_step(self.dhps))
+        self._cache: Optional[Dict[int, Any]] = {} if cache_teacher else None
+        self._obs = obs.registry_for(hps)
+        self._c_steps = self._obs.counter("train/distill_steps_total")
+        self._g_loss = self._obs.gauge("train/distill_loss")
+        self._m_teacher = self._obs.histogram(
+            "train/distill_teacher_seconds")
+
+    def draft_params(self) -> Any:
+        return self.state.params
+
+    def _teacher_arrays(self, batch: Any) -> Dict[str, np.ndarray]:
+        if self._cache is not None and id(batch) in self._cache:
+            # the cache holds (batch, arrays): the batch ref pins the
+            # object alive, so an id() can never be recycled under us
+            return self._cache[id(batch)][1]
+        t0 = time.monotonic()
+        arrays = teacher_arrays(self.full_params, self.hps,
+                                batch.as_arrays())
+        self._m_teacher.observe(time.monotonic() - t0)
+        if self._cache is not None:
+            self._cache[id(batch)] = (batch, arrays)
+        return arrays
+
+    def _save(self) -> None:
+        if self.checkpointer is None:
+            return
+        self.checkpointer.save(self.state)
+        sidecar = os.path.join(self.checkpointer.directory, TEACHER_SIDECAR)
+        with open(sidecar, "w", encoding="utf-8") as f:
+            json.dump({"teacher_sha": self._teacher_sha}, f)
+
+    def distill(self, num_steps: int) -> trainer_lib.TrainState:
+        """Run ``num_steps`` distillation steps (or until the batcher
+        exhausts); saves the draft checkpoint + teacher sidecar at the
+        cadence and at the end."""
+        state = self._distill_steps(num_steps)
+        self._save()
+        return state
+
+    def _flush_metrics(self, pending) -> None:
+        """One D2H fetch for a window of device-resident losses (the
+        Trainer's windowed-watchdog discipline: detection deferred at
+        most metrics_every steps)."""
+        if not pending:
+            return
+        fetched = jax.device_get([m for _, m in pending])
+        for (step, _), m in zip(pending, fetched):
+            loss = float(m.loss)
+            self._g_loss.set(loss)
+            log.info("distill step %d loss %f", step, loss)
+            if not np.isfinite(loss):
+                raise trainer_lib.NonFiniteLossError(
+                    f"distillation loss is not finite at step {step}")
+
+    def _distill_steps(self, limit: int) -> trainer_lib.TrainState:
+        last_ckpt = time.monotonic()
+        pending = []
+        step = int(self.state.step)
+        start = step
+        while not limit or step - start < limit:
+            batch = self.batcher.next_batch()
+            if batch is None:
+                break
+            arrays = self._teacher_arrays(batch)
+            self.state, metrics = self._step_fn(self.state, arrays)
+            step += 1
+            pending.append((step, metrics))
+            self._c_steps.inc()
+            if len(pending) >= self.metrics_every:
+                self._flush_metrics(pending)
+                pending = []
+            if self.checkpointer is not None and \
+                    time.monotonic() - last_ckpt >= self.checkpoint_secs:
+                self._flush_metrics(pending)
+                pending = []
+                self._save()
+                last_ckpt = time.monotonic()
+        self._flush_metrics(pending)
+        return self.state

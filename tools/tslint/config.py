@@ -84,6 +84,17 @@ DEFAULT: Dict[str, Any] = {
                 r"^_spec_body",  # covers the <locals>.body cycle closure
                 r"^spec_verify",
                 r"^decode_onestep",  # pg + avg_attention decode steps
+                # the distilled-narrow-draft spec tier (ISSUE 12): the
+                # distillation step loop dispatches once per draft
+                # optimizer step, the adaptive host loop dispatches
+                # once per draft-verify CYCLE (its single histogram
+                # fetch is the sanctioned, suppressed controller
+                # input), and the controller's observe/update run
+                # between every pair of cycles — a stray sync in any
+                # of them serializes the tier back to per-token cost
+                r"^DistillTrainer\._distill_steps$",
+                r"^run_spec_decode_adaptive$",
+                r"^SpecKController\.(observe|update)$",
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
