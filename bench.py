@@ -57,7 +57,13 @@ Modes (BENCH_MODE):
                     (BENCH_SERVE_TIER, microbatch only) benches one
                     quality tier — spec rows carry measured acceptance
                     rate + the implied expected speedup (SERVING.md
-                    "Quality tiers").
+                    "Quality tiers");
+                    `--serve-replicas=N` (BENCH_SERVE_REPLICAS, with
+                    `--serve-hedge-ms` / BENCH_SERVE_HEDGE_MS) routes
+                    the load through the ISSUE-13 FleetRouter over N
+                    in-process replicas — fleet rows carry hedge
+                    spend/wins and requeue counts (SERVING.md "Elastic
+                    fleet") and fingerprint their topology.
   bytes           — XLA cost-analysis byte accounting for the train
                     step (no execution; CPU-forced like input mode):
                     bytes accessed + intensity for the baseline config
@@ -403,6 +409,17 @@ def _config_fingerprint() -> dict:
                 float(os.environ.get("BENCH_SERVE_SHORT_RATIO", "0.75")))
             if sr != 0.75:
                 fp["short_ratio"] = sr
+        # elastic-fleet axis (ISSUE 13): N routed replicas run a
+        # DIFFERENT serving topology than one server (router hop,
+        # hedging, per-replica queues) — fleet rows must never stand in
+        # for single-server rows.  Non-default only, per house
+        # convention, so banked records keep matching; the hedge budget
+        # rides along whenever it is armed (hedged and unhedged fleets
+        # do different work).
+        if os.environ.get("BENCH_SERVE_REPLICAS", "1") not in ("", "1"):
+            fp["replicas"] = int(os.environ["BENCH_SERVE_REPLICAS"])
+            if float(os.environ.get("BENCH_SERVE_HEDGE_MS", "0") or 0):
+                fp["hedge_ms"] = float(os.environ["BENCH_SERVE_HEDGE_MS"])
     if mode == "decode":
         # while vs scan vs chunked decode loops differ by ~1.4 ms per
         # dynamic iteration on the tunneled backend — never
@@ -1383,10 +1400,14 @@ def bench_serve() -> None:
             "BENCH_SERVE_TIER or use BENCH_SERVE_MODE=microbatch")
     slots = int(os.environ.get("BENCH_SERVE_SLOTS", "0"))
     refill_chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "0"))
+    replicas_n = int(os.environ.get("BENCH_SERVE_REPLICAS", "1"))
+    hedge_ms = float(os.environ.get("BENCH_SERVE_HEDGE_MS", "0"))
     hps = HParams(batch_size=batch, mode="decode", coverage=True,
                   serve_max_wait_ms=wait_ms, serve_mode=serve_mode,
                   serve_slots=slots, serve_refill_chunk=refill_chunk,
-                  serve_max_queue=max(256, reqs), **_preset_overrides())
+                  serve_max_queue=max(256, reqs),
+                  serve_replicas=replicas_n, serve_hedge_ms=hedge_ms,
+                  **_preset_overrides())
     if tier in ("spec", "draft"):
         # the draft model source: the mapped bootstrap for the
         # transformer family (the real serving recipe), fresh init for
@@ -1455,7 +1476,24 @@ def bench_serve() -> None:
     try:
         decoder = BeamSearchDecoder(hps, vocab, batcher=None, params=params,
                                     decode_root=tmp)
-        server = ServingServer(hps, vocab, decoder=decoder)
+        if replicas_n > 1:
+            # the elastic fleet (ISSUE 13; --serve-replicas): N
+            # in-process replicas behind the REAL FleetRouter, sharing
+            # the process registry (counters/histograms aggregate
+            # across replicas; the per-replica gauges last-writer-win —
+            # routing reads each replica's live stats() surface, not
+            # the gauges) and the ONE decoder (shared jit cache: the
+            # fleet row benches routing + dispatch concurrency, not N
+            # redundant compiles)
+            from textsummarization_on_flink_tpu.serve.fleet import (
+                FleetRouter,
+            )
+
+            server = FleetRouter(
+                [ServingServer(hps, vocab, decoder=decoder)
+                 for _ in range(replicas_n)], hps)
+        else:
+            server = ServingServer(hps, vocab, decoder=decoder)
         reg = obs.registry()
         fill_h = reg.histogram("serve/batch_fill")
         occ_h = reg.histogram("serve/slot_occupancy")
@@ -1624,6 +1662,18 @@ def bench_serve() -> None:
             "timing": "wall-clock per request, enqueue -> resolved future "
                       "(queue wait + coalescing window included)",
         }
+        if replicas_n > 1:
+            # fleet evidence (ISSUE 13): hedge spend/wins and requeues
+            # ride the row so a fleet measurement carries its own
+            # redundant-work accounting (FastSeq's lesson, priced)
+            rec["replicas"] = replicas_n
+            rec["hedge_ms"] = hedge_ms
+            rec["hedges_total"] = int(
+                reg.counter("serve/hedges_total").value)
+            rec["hedge_wins_total"] = int(
+                reg.counter("serve/hedge_wins_total").value)
+            rec["requeued_total"] = int(
+                reg.counter("serve/requeued_total").value)
         if tier == "spec":
             # measured acceptance -> expected speedup (the BYTE_BUDGET
             # "spec" evidence trail): acceptance comes from THIS run's
@@ -1930,6 +1980,12 @@ if __name__ == "__main__":
         elif arg.startswith("--serve-short-ratio="):
             os.environ["BENCH_MODE"] = "serve"
             os.environ["BENCH_SERVE_SHORT_RATIO"] = arg.split("=", 1)[1]
+        elif arg.startswith("--serve-replicas="):
+            os.environ["BENCH_MODE"] = "serve"
+            os.environ["BENCH_SERVE_REPLICAS"] = arg.split("=", 1)[1]
+        elif arg.startswith("--serve-hedge-ms="):
+            os.environ["BENCH_MODE"] = "serve"
+            os.environ["BENCH_SERVE_HEDGE_MS"] = arg.split("=", 1)[1]
     if os.environ.get("TS_BENCH_CHILD") == "1":
         child_main()
     else:

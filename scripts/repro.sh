@@ -68,6 +68,15 @@ echo "== serve smoke (CollectionSource -> ServingServer -> CollectionSink)"
 # asserted between the two engines and the prefill telemetry checked
 python scripts/serve_smoke.py
 
+echo "== fleet smoke (3 replicas, kill one under load, exactly-once + parity)"
+# the ISSUE-13 elastic fleet end to end on a real tiny model: the
+# threaded FleetRouter fronts 3 in-process replicas, one is killed
+# mid-decode, its residents/queued requests requeue on survivors, and
+# the answers stay row-identical to a single-server run (the committed
+# virtual-time swap/hedge/kill gates live in SERVE_SLO.json "fleet",
+# enforced in the suite above)
+python scripts/fleet_smoke.py
+
 echo "== speculative-tier smoke (draft init -> spec decode -> exactness)"
 # the ISSUE-10 fast path end to end: AAN draft mapped from the full
 # model's own params, draft-then-verify decode through the decoder's

@@ -211,8 +211,22 @@ def request_timeline(paths, uuid: str) -> dict:
                         spans.append(r)
         spans.sort(key=lambda r: r.get("ts_us", 0))
     first = {}
-    for r in events:  # first occurrence of each lifecycle stage wins
+    resolves: list = []
+    for r in events:  # first occurrence of each lifecycle stage wins...
+        if r.get("event") == "resolve":
+            resolves.append(r)
+            continue
         first.setdefault(r.get("event"), r.get("ts_us", 0))
+    # ...except resolve: a fleet-routed uuid resolves a replica-level
+    # future per attempt (a killed replica's typed rejection, a hedge
+    # loser) before the ROUTER future settles — the terminal resolve is
+    # the one tagged scope=fleet when present, else the last one seen
+    # (plain single-server timelines have exactly one either way)
+    if resolves:
+        tagged = [r for r in resolves
+                  if (r.get("attrs") or {}).get("scope")]
+        first["resolve"] = (tagged[-1] if tagged
+                            else resolves[-1]).get("ts_us", 0)
     phases = {}
 
     def _ms(a, b):

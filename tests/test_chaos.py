@@ -208,9 +208,12 @@ class TestSourceReconnect:
         assert reg.counter("resilience/io_reconnects_total").value == 1
 
     def test_dedup_window_bounds_memory(self, _isolated_obs_and_faults):
-        """Dedup memory is a bounded FIFO window, not an ever-growing
+        """Dedup memory is a bounded LRU window, not an ever-growing
         set: keys inside the window still dedup, keys evicted from it
-        are re-delivered (the documented tradeoff on endless streams)."""
+        are re-delivered (the documented tradeoff on endless streams),
+        and every eviction is counted
+        (``pipeline/dedup_evictions_total``, the ISSUE-13 satellite)."""
+        reg = _isolated_obs_and_faults
         calls = {"n": 0}
 
         class FlakySource(io_lib.Source):
@@ -231,6 +234,39 @@ class TestSourceReconnect:
             schema=io_lib.ARTICLE_INPUT_SCHEMA, sleep=lambda d: None)
         keys = [r[0] for r in src.rows()]
         assert keys == ["u0", "u1", "u2", "u0", "u3"]
+        assert reg.counter("pipeline/dedup_evictions_total").value == 3
+
+    def test_dedup_lru_refresh_protects_replayed_keys(
+            self, _isolated_obs_and_faults):
+        """The LRU half of the ISSUE-13 satellite: a replayed key
+        refreshes its recency, so a peer that replays the same prefix
+        on every reconnect cannot age live keys out of the window (the
+        FIFO window would have re-delivered u0 here — a duplicate
+        leak)."""
+        reg = _isolated_obs_and_faults
+        calls = {"n": 0}
+
+        class FlakySource(io_lib.Source):
+            schema = io_lib.ARTICLE_INPUT_SCHEMA
+
+            def rows(self):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    yield ("u0", "a", "", "r")
+                    yield ("u1", "a", "", "r")
+                    raise ConnectionResetError("flap")
+                yield ("u0", "a", "", "r")  # replayed: refreshes u0
+                yield ("u2", "a", "", "r")  # evicts u1, NOT fresh u0
+                yield ("u0", "a", "", "r")  # still inside the window
+                yield ("u3", "a", "", "r")
+
+        src = io_lib.ResilientSource(
+            FlakySource, max_reconnects=2, seed=0, dedup_window=2,
+            schema=io_lib.ARTICLE_INPUT_SCHEMA, sleep=lambda d: None)
+        keys = [r[0] for r in src.rows()]
+        assert keys == ["u0", "u1", "u2", "u3"]  # u0 never re-delivered
+        assert reg.counter("resilience/io_dup_rows_total").value == 2
+        assert reg.counter("pipeline/dedup_evictions_total").value == 2
 
     def test_reconnect_budget_exhausted_raises_typed(
             self, _isolated_obs_and_faults):

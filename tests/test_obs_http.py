@@ -162,6 +162,55 @@ class TestHealthz:
         status, body = _get(srv.port, "/healthz")
         assert status == 200
 
+    def test_healthz_carries_serve_routing_inputs(self, served):
+        """The ISSUE-13 satellite: the FleetRouter's routing inputs —
+        queue depth, free slots, effective serve_mode — ride the
+        /healthz JSON body (scrapeable, not in-process only), while the
+        503 policy stays exactly heartbeat-staleness."""
+        reg, srv = served
+        status, body = _get(srv.port, "/healthz")
+        assert "serve" not in json.loads(body)  # absent until published
+        reg.gauge("serve/queue_depth").set(3)
+        reg.gauge("serve/slots_free").set(2)
+        obs_http.set_health_info(reg, serve_mode="continuous")
+        status, body = _get(srv.port, "/healthz")
+        payload = json.loads(body)
+        assert status == 200 and payload["status"] == "ok"
+        assert payload["serve"] == {"queue_depth": 3, "slots_free": 2,
+                                    "serve_mode": "continuous"}
+        # routing inputs are informational: a deep queue never 503s
+        reg.gauge("serve/queue_depth").set(10_000)
+        status, _ = _get(srv.port, "/healthz")
+        assert status == 200
+
+    def test_serving_server_publishes_healthz_serve_section(self):
+        """End to end through a real continuous ServingServer: the
+        health payload carries the gauges the server maintains plus its
+        effective mode."""
+        from textsummarization_on_flink_tpu.config import HParams
+        from textsummarization_on_flink_tpu.data.vocab import Vocab
+        from textsummarization_on_flink_tpu.serve.server import ServingServer
+        from tests.test_serve import StubEngine
+
+        reg = Registry()
+        vocab = Vocab(words=["a", "b", "."])
+        hps = HParams(mode="decode", batch_size=2, vocab_size=vocab.size(),
+                      max_enc_steps=8, max_dec_steps=4, beam_size=2,
+                      min_dec_steps=1, max_oov_buckets=4,
+                      serve_mode="continuous", serve_slots=2,
+                      serve_refill_chunk=1, serve_max_queue=8)
+
+        class _NullDecoder:
+            def maybe_reload_checkpoint(self, last):
+                return last
+
+        ServingServer(hps, vocab, decoder=_NullDecoder(),
+                      engine=StubEngine(slots=2), registry=reg)
+        payload = obs_http.health(reg)
+        assert payload["serve"]["serve_mode"] == "continuous"
+        assert payload["serve"]["slots_free"] == 2
+        assert payload["serve"]["queue_depth"] == 0
+
     def test_open_breaker_reported_but_informational(self, served):
         """An OPEN breaker is visible on /healthz but must NOT 503 it:
         503-ing an open ADMISSION breaker drains the instance, which

@@ -236,6 +236,53 @@ class TestCircuitBreaker:
         clock.t = 61.5
         assert br.state == CircuitBreaker.HALF_OPEN
 
+    def test_half_open_concurrent_callers_get_exactly_one_probe(self):
+        """The ISSUE-13 satellite regression: N threads racing into a
+        HALF_OPEN breaker must yield EXACTLY ONE probe grant — every
+        loser sees the breaker as open (shed), they do not all probe at
+        once."""
+        import threading
+
+        br, clock, reg = self.make(threshold=1, reset_secs=30.0)
+        br.record_failure()
+        clock.t = 31.0  # into the half-open window
+        grants = []
+        n = 12
+        barrier = threading.Barrier(n)
+
+        def caller():
+            barrier.wait()
+            if br.allow():
+                grants.append(threading.get_ident())
+
+        threads = [threading.Thread(target=caller) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(grants) == 1, \
+            f"{len(grants)} concurrent half-open probes granted"
+        assert reg.counter("resilience/t/breaker_shed_total").value == n - 1
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_lost_probe_lease_expires_and_regrants(self):
+        """A probe whose caller vanished without recording an outcome
+        must not wedge the breaker half-open forever: after another
+        reset_secs the single probe slot re-grants."""
+        br, clock, _ = self.make(threshold=1, reset_secs=30.0)
+        br.record_failure()
+        clock.t = 31.0
+        assert br.allow()        # the probe caller then VANISHES
+        assert not br.allow()    # the slot is taken
+        clock.t = 60.0           # 29s later: lease still live
+        assert not br.allow()
+        clock.t = 61.5           # lease (reset_secs) expired
+        assert br.allow()        # re-granted instead of wedged
+        assert not br.allow()    # still exactly one in flight
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED
+
     def test_context_manager(self):
         br, clock, _ = self.make(threshold=1)
         with pytest.raises(OSError):
@@ -282,7 +329,7 @@ class TestFaultSpecs:
         assert set(faultinject.KNOWN_POINTS) == {
             "io.connect", "io.read", "io.write",
             "ckpt.load", "train.step_nan", "etl.worker",
-            "serve.dispatch"}
+            "serve.dispatch", "serve.replica_kill"}
 
 
 class TestFaultPlan:

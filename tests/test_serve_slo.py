@@ -438,3 +438,226 @@ def test_disagg_runs_through_the_real_prefill_queue(slo, disagg_measured):
     wl.update(slo["disaggregated"]["workload"])
     assert disagg_measured["prefills"] == disagg_measured["requests"]
     assert disagg_measured["prefill_bucket_mean"] < wl["long_words"]
+
+
+# -- elastic serving fleet (ISSUE 13) --------------------------------------
+#
+# Fleet-level virtual time: the REAL FleetRouter + ServingServers +
+# ContinuousBatchers, driven single-threaded over a shared round clock
+# (one round = every live replica ticks once, in parallel; the clock
+# advances chunk * step_cost_ms per round) with deterministic arrivals.
+# Routing decisions, hedge timing, the rolling-swap state machine, and
+# the replica-kill requeue path are all exact scheduling facts — see
+# SERVE_SLO.json "fleet" _comment for the committed scenarios.
+
+
+class _VClock:
+    """The fleet's shared virtual clock, advanced by the round driver
+    (replicas run concurrently, so ONE advance per round, not one per
+    replica tick)."""
+
+    def __init__(self):
+        self.ms = 0.0
+
+    def now(self) -> float:  # seconds, the router's clock unit
+        return self.ms / 1000.0
+
+
+class FleetSimEngine:
+    """SlotDecodeEngine-protocol sim over the SHARED fleet clock.
+    ``speed`` < 1 models a degraded replica (the hedge scenario's
+    straggler source): its residents advance speed * chunk steps per
+    round while healthy neighbors advance the full chunk."""
+
+    def __init__(self, wl, vclock, speed: float = 1.0):
+        self.slots = wl["slots"]
+        self.chunk = wl["chunk"]
+        self.speed = speed
+        self._wl = wl
+        self._vclock = vclock
+        self._remaining = [0.0] * self.slots
+        self._active = [False] * self.slots
+        self.vresolve = {}
+
+    def pack(self, idx, example):
+        assert not self._active[idx]
+        self._active[idx] = True
+        self._remaining[idx] = _steps_for(example, self._wl)
+
+    def step(self):
+        fin = []
+        for i in range(self.slots):
+            if self._active[i]:
+                self._remaining[i] -= self.chunk * self.speed
+                if self._remaining[i] <= 0:
+                    fin.append(i)
+        return fin
+
+    def unpack(self, idx, example):
+        assert self._active[idx]
+        self._active[idx] = False
+        # first-wins: a hedged uuid may unpack on two replicas; the
+        # caller observed the EARLIER one
+        prev = self.vresolve.get(example.uuid)
+        if prev is None or self._vclock.ms < prev:
+            self.vresolve[example.uuid] = self._vclock.ms
+        return DecodedResult(
+            uuid=example.uuid, article=example.original_article,
+            decoded_words=["ok", "."], reference=example.reference,
+            abstract_sents=[])
+
+    def release(self, idx):
+        self._active[idx] = False
+
+
+def _run_fleet(slo, swap: bool = False, kill: bool = False,
+               slow: bool = False):
+    """Drive the committed fleet workload through the REAL router;
+    returns (per-uuid virtual resolve times, fleet registry, captured
+    request events, results)."""
+    from textsummarization_on_flink_tpu.obs.export import MemorySink
+    from textsummarization_on_flink_tpu.serve.fleet import FleetRouter
+
+    wl = slo["fleet"]["workload"]
+    vocab = Vocab(words=WORDS)
+    vclock = _VClock()
+    hps = HParams(
+        mode="decode", batch_size=wl["slots"], vocab_size=vocab.size(),
+        max_enc_steps=wl["long_words"], max_dec_steps=wl["long_steps"],
+        beam_size=2, min_dec_steps=1, max_oov_buckets=4,
+        serve_max_queue=max(4 * wl["requests"], 64),
+        serve_mode="continuous", serve_slots=wl["slots"],
+        serve_refill_chunk=wl["chunk"],
+        serve_hedge_ms=wl["hedge_ms"],
+        serve_hedge_max_ratio=wl["hedge_max_ratio"])
+    fleet_reg = Registry()
+    sink = MemorySink()
+    fleet_reg.event_sink = sink
+    servers, engines = [], []
+    for r in range(wl["replicas"]):
+        eng = FleetSimEngine(
+            wl, vclock,
+            speed=wl["slow_factor"] if (slow and r == 0) else 1.0)
+        servers.append(ServingServer(
+            hps, vocab, decoder=_NullDecoder(), engine=eng,
+            registry=Registry()))
+        engines.append(eng)
+    router = FleetRouter(servers, hps, registry=fleet_reg,
+                         clock=vclock.now)
+    arts = _articles({**slo["workload"], **wl})
+    futs, i, rounds = [], 0, 0
+    while True:
+        rounds += 1
+        assert rounds < 5000, "fleet virtual run did not converge"
+        for _ in range(wl["arrive_per_round"]):
+            if i < len(arts):
+                futs.append(router.submit(arts[i], uuid=f"u{i}"))
+                i += 1
+        if kill and rounds == wl["kill_round"]:
+            alive = [h for h in router.replicas() if not h.killed]
+            victim = max(alive, key=lambda h: h.load())
+            assert victim.server.load() > 0, \
+                "kill scenario must catch the victim mid-decode"
+            router.kill_replica(victim.rid)
+        if swap and rounds == wl["swap_start_round"] \
+                and not router.swap_active() \
+                and not fleet_reg.counter("serve/fleet_swaps_total").value:
+            router.start_rolling_swap()
+        router.tick()
+        for srv, h in zip(servers, router.replicas()):
+            if not h.killed:
+                srv.tick_once(poll=0.0)
+        vclock.ms += wl["chunk"] * wl["step_cost_ms"]
+        if i >= len(arts) and all(f.done() for f in futs) \
+                and not router.swap_active():
+            break
+    results = [f.result(timeout=0) for f in futs]
+    router.stop()
+    # exactly-once, fleet-level: one result per admitted uuid, in order
+    assert [r.uuid for r in results] == \
+        [f"u{k}" for k in range(wl["requests"])]
+    resolve = {}
+    for eng in engines:
+        for u, t in eng.vresolve.items():
+            resolve[u] = min(resolve.get(u, t), t)
+    assert set(resolve) == {f"u{k}" for k in range(wl["requests"])}
+    events = [r for r in sink.records() if r.get("kind") == "request"]
+    return resolve, fleet_reg, events, results
+
+
+@pytest.fixture(scope="module")
+def fleet_measured(slo):
+    steady_resolve, steady_reg, _, _ = _run_fleet(slo)
+    swap_resolve, swap_reg, _, _ = _run_fleet(slo, swap=True)
+    return {
+        "steady_p99": _p99(steady_resolve.values()),
+        "swap_p99": _p99(swap_resolve.values()),
+        "swaps": swap_reg.counter("serve/fleet_swaps_total").value,
+    }
+
+
+def test_fleet_steady_p99_within_committed_ceiling(slo, fleet_measured):
+    ceiling = slo["fleet"]["steady_p99_virtual_ms_max"]
+    assert fleet_measured["steady_p99"] <= ceiling, (
+        f"fleet steady-state p99 rose to {fleet_measured['steady_p99']:.0f}"
+        f" virtual ms (committed ceiling {ceiling:.0f}) — routing or the "
+        f"round scheduler regressed (see SERVE_SLO.json fleet._comment)")
+
+
+def test_fleet_rolling_swap_p99_within_committed_ratio(slo, fleet_measured):
+    """The upgrade tax: a replica-at-a-time drain -> hot-swap -> readmit
+    pass must not cost the fleet more than the committed p99 ratio over
+    steady state — and the swap must actually visit every replica."""
+    ratio_max = slo["fleet"]["swap_p99_ratio_max"]
+    ratio = fleet_measured["swap_p99"] / fleet_measured["steady_p99"]
+    assert ratio <= ratio_max, (
+        f"fleet p99 under rolling swap / steady-state p99 = {ratio:.2f} "
+        f"(committed max {ratio_max:.2f}) — draining one replica at a "
+        f"time is costing more than the committed upgrade tax")
+    assert fleet_measured["swaps"] == slo["fleet"]["swap_count_expected"], (
+        f"rolling swap completed {fleet_measured['swaps']:.0f} of "
+        f"{slo['fleet']['swap_count_expected']} replica hot-swaps")
+
+
+def test_fleet_hedge_wins_counted_and_rate_capped(slo):
+    """Hedging must PAY (a degraded replica's stragglers resolve from
+    their hedge twins) and must stay CAPPED (a hedge is a purchased
+    duplicate; spend rides the committed serve_hedge_max_ratio
+    ceiling)."""
+    _, reg, _, _ = _run_fleet(slo, slow=True)
+    hedges = reg.counter("serve/hedges_total").value
+    wins = reg.counter("serve/hedge_wins_total").value
+    submitted = reg.counter("serve/fleet_submitted_total").value
+    assert wins >= slo["fleet"]["hedge_wins_min"], (
+        f"only {wins:.0f} hedge wins against the slow replica (committed "
+        f"min {slo['fleet']['hedge_wins_min']}) — hedging stopped paying")
+    assert hedges >= wins, "a hedge win without a hedge is an accounting bug"
+    rate = hedges / submitted
+    assert rate <= slo["fleet"]["hedge_rate_max"], (
+        f"hedge rate {rate:.3f} exceeds the committed ceiling "
+        f"{slo['fleet']['hedge_rate_max']} — the waste cap broke")
+
+
+def test_fleet_replica_kill_exactly_once_with_requeue(slo):
+    """The chaos gate (ISSUE 13 acceptance): a replica killed mid-decode
+    under load -> every admitted request still resolves exactly once
+    with a RESULT (no lost futures, no double resolution, no
+    caller-visible errors), the orphans re-enqueued on survivors through
+    the typed path and tagged with `requeued` trace events."""
+    resolve, reg, events, results = _run_fleet(slo, kill=True)
+    wl = slo["fleet"]["workload"]
+    assert reg.counter("serve/replica_kills_total").value == 1
+    requeued = reg.counter("serve/requeued_total").value
+    assert requeued >= slo["fleet"]["kill_requeued_min"], (
+        f"replica death orphaned no requests ({requeued:.0f} requeued) — "
+        f"the kill landed on an idle replica, not mid-decode")
+    # every requeued request is tagged in the trace stream with the
+    # corpse it left and the survivor it landed on
+    tags = [e for e in events if e.get("event") == "requeued"]
+    assert len(tags) == int(requeued)
+    for e in tags:
+        assert e["attrs"]["from_replica"] != e["attrs"]["to_replica"]
+        assert e["attrs"]["cause"] == "ReplicaKilledError"
+    # no admitted request saw the failure: all resolved with results
+    assert len(results) == wl["requests"]
+    assert len({r.uuid for r in results}) == wl["requests"]

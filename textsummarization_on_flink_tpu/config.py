@@ -318,6 +318,26 @@ class HParams:
     # the observed full-beam latency is re-tiered HERE instead (and a
     # spec request to "draft"), per REQUEST, not per batch.
     serve_degrade_tier: str = "greedy"
+    # ---- elastic serving fleet (SERVING.md "Elastic fleet"; ISSUE 13) ----
+    # In-process ServingServer replicas behind the FleetRouter
+    # (serve/fleet.py): 1 (default) = the single-server path, no router.
+    # More replicas buy drain/upgrade/failover independence — a replica
+    # can be hot-swapped or lost without touching its neighbors' queues.
+    serve_replicas: int = 1
+    # Request-hedging latency budget in milliseconds: once a routed
+    # request has been outstanding this long, the router duplicates it
+    # to a second replica and the FIRST resolution wins (the loser's
+    # result is discarded — the exactly-once future never resolves
+    # twice).  0 (default) = hedging off.  A hedge is a PURCHASED
+    # duplicate (FastSeq: never do redundant work), so every hedge is
+    # counted (serve/hedges_total, serve/hedge_wins_total) and the
+    # spend is capped by serve_hedge_max_ratio.
+    serve_hedge_ms: float = 0.0
+    # Hedge-rate ceiling: hedged requests may never exceed this
+    # fraction of fleet admissions (over-budget hedge candidates are
+    # counted in serve/hedge_suppressed_total and left to their
+    # primary).  The committed gate value lives in SERVE_SLO.json.
+    serve_hedge_max_ratio: float = 0.1
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score
@@ -579,6 +599,17 @@ class HParams:
             raise ValueError(
                 f"serve_prefill_depth must be >= 0, got "
                 f"{self.serve_prefill_depth}")
+        if self.serve_replicas < 1:
+            raise ValueError(
+                f"serve_replicas must be >= 1, got {self.serve_replicas}")
+        if self.serve_hedge_ms < 0:
+            raise ValueError(
+                f"serve_hedge_ms must be >= 0 (0 = hedging off), got "
+                f"{self.serve_hedge_ms}")
+        if not 0.0 <= self.serve_hedge_max_ratio <= 1.0:
+            raise ValueError(
+                f"serve_hedge_max_ratio must be in [0, 1], got "
+                f"{self.serve_hedge_max_ratio}")
         if self.faults:
             # parse for validation only (unknown points / bad probs fail
             # here, at config time, not at the injection site)

@@ -135,6 +135,29 @@ def retire_heartbeat(registry: Registry, name: str) -> None:
     registry.heartbeats.retire(name)
 
 
+def set_health_info(registry: Registry, **info: Any) -> None:
+    """Publish non-numeric health facts (e.g. the serving layer's
+    effective ``serve_mode``) into `registry`'s /healthz payload.
+    No-op when the registry is disabled."""
+    if not registry.enabled:
+        return
+    current = getattr(registry, "health_info", None)
+    if current is None:
+        registry.health_info = dict(info)
+    else:
+        current.update(info)
+
+
+#: gauges the /healthz body surfaces as routing inputs (ISSUE 13: the
+#: FleetRouter's least-loaded pick reads queue depth and free slots off
+#: each replica's health plane — they must be scrapeable, not in-process
+#: only).  Reported only when the gauge exists on the registry.
+_SERVE_HEALTH_GAUGES = (
+    ("queue_depth", "serve/queue_depth"),
+    ("slots_free", "serve/slots_free"),
+)
+
+
 def health(registry: Registry,
            stale_factor: float = STALE_FACTOR) -> Dict[str, Any]:
     """The /healthz payload: heartbeat statuses + breaker states.
@@ -160,12 +183,28 @@ def health(registry: Registry,
         short = name[len("resilience/"):-len("/breaker_state")] \
             if name.startswith("resilience/") else name
         breakers[short] = _BREAKER_STATES.get(code, str(code))
+    # serving routing inputs (ISSUE 13): queue depth / free slots off
+    # the existing gauges plus any published facts (effective
+    # serve_mode).  Informational like the breakers — they never flip
+    # the 503; the FleetRouter (and any external LB) reads them to pick
+    # the least-loaded replica without a second endpoint.
+    serve: Dict[str, Any] = {}
+    names = set(registry.names())
+    for key, metric in _SERVE_HEALTH_GAUGES:
+        if metric in names:
+            serve[key] = getattr(registry.get(metric), "value", 0)
+    info = getattr(registry, "health_info", None)
+    if info:
+        serve.update(info)
     degraded = any(not c["ok"] for c in components.values())
-    return {
+    payload: Dict[str, Any] = {
         "status": "degraded" if degraded else "ok",
         "components": components,
         "breakers": breakers,
     }
+    if serve:
+        payload["serve"] = serve
+    return payload
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):

@@ -310,6 +310,11 @@ class Registry:
         # flight recorder (obs.flightrec.install_flight_recorder)
         self.heartbeats = None  # obs.http.HeartbeatBoard
         self.flight = None  # obs.flightrec.FlightRecorder
+        # non-numeric health facts a component wants on /healthz (e.g.
+        # the serving layer's effective serve_mode — ISSUE 13: the
+        # router's routing inputs must be scrapeable); set through
+        # obs.http.set_health_info, read by obs.http.health
+        self.health_info = None  # Optional[Dict[str, Any]]
 
     def _get_or_create(self, name: str, cls, *args):
         with self._lock:

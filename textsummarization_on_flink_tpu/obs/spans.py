@@ -331,7 +331,8 @@ def request_event(registry: Registry, event: str,
     OBSERVABILITY.md "Request-scoped tracing"):
 
         {"kind": "request", "event": "enqueue" | "admit" | "slot" |
-         "finish" | "evict" | "resolve" | "shed", "uuid": ...,
+         "finish" | "evict" | "resolve" | "shed" | "route" | "hedge" |
+         "requeued", "uuid": ...,
          "ts_us": ..., "trace_id": ..., "span_id": ..., "pid": ...,
          "attrs": {...}}
 

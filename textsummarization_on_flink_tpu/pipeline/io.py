@@ -410,11 +410,15 @@ class ResilientSource(Source):
     duplicates are counted in ``resilience/io_dup_rows_total``, and
     reconnects in ``resilience/io_reconnects_total``.  Pass
     ``dedup=False`` for schemas whose first column is not a unique key.
-    The dedup memory is BOUNDED: only the most recent ``dedup_window``
-    keys are held (FIFO eviction, default 65536) — the window only needs
-    to cover replay depth since the last reconnect, and an unbounded set
-    would leak on exactly the long-running streams this wrapper is for;
-    ``dedup_window=0`` keeps every key (short bounded streams).
+    The dedup memory is BOUNDED: only the ``dedup_window``
+    least-recently-SEEN keys are held (LRU — a replayed key refreshes
+    its recency, so a peer that replays the same prefix on every
+    reconnect cannot age the live keys out; default 65536, evictions
+    counted in ``pipeline/dedup_evictions_total``).  The window only
+    needs to cover replay depth since the last reconnect, and an
+    unbounded set would leak on exactly the long-running streams this
+    wrapper is for; ``dedup_window=0`` keeps every key (short bounded
+    streams).
 
     ``seed``/``sleep`` pin the backoff for deterministic chaos tests.
     """
@@ -435,6 +439,7 @@ class ResilientSource(Source):
         self._sleep = sleep
         self._c_reconnects = obs.counter("resilience/io_reconnects_total")
         self._c_dups = obs.counter("resilience/io_dup_rows_total")
+        self._c_dedup_evicted = obs.counter("pipeline/dedup_evictions_total")
         self.schema = schema or factory().schema
 
     def rows(self) -> Iterator[Row]:
@@ -450,11 +455,15 @@ class ResilientSource(Source):
                     if self._dedup:
                         key = row[0] if row else None
                         if key in seen:
+                            # LRU refresh: a replayed key is evidence it
+                            # is still live replay depth — keep it young
+                            seen.move_to_end(key)
                             self._c_dups.inc()
                             continue
                         seen[key] = None
                         if self._dedup_window and len(seen) > self._dedup_window:
-                            seen.popitem(last=False)  # FIFO eviction
+                            seen.popitem(last=False)  # oldest-seen out
+                            self._c_dedup_evicted.inc()
                     yield row
                 return  # clean end of stream
             except _RECONNECT_ERRORS as e:

@@ -12,6 +12,9 @@ injection points* compiled into the production code:
   ``ckpt.load``       checkpoint/checkpointer.py — checksum-verified load
   ``train.step_nan``  train/trainer.py — per-dispatch divergence watchdog
   ``etl.worker``      data/batcher.py — example-producer worker loop
+  ``serve.dispatch``  serve/server.py — per-(sub-)batch / per-tick dispatch
+  ``serve.replica_kill``  serve/fleet.py — kills one fleet replica
+                      mid-decode (residents/queued requeue on survivors)
   ==================  =====================================================
 
 Arming — either source, same ``point:prob:seed[:max]`` syntax, comma-
@@ -55,7 +58,7 @@ ENV_VAR = "TS_FAULTS"
 KNOWN_POINTS = (
     "io.connect", "io.read", "io.write",
     "ckpt.load", "train.step_nan", "etl.worker",
-    "serve.dispatch",
+    "serve.dispatch", "serve.replica_kill",
 )
 
 

@@ -195,8 +195,16 @@ class CircuitBreaker:
     * CLOSED: calls flow; `threshold` CONSECUTIVE failures trip it open.
     * OPEN: ``allow()`` is False (callers shed) until ``reset_secs``
       elapse, then the breaker moves to HALF_OPEN.
-    * HALF_OPEN: one probe call is allowed; success re-closes, failure
-      re-opens (and restarts the reset clock).
+    * HALF_OPEN: EXACTLY ONE in-flight probe is allowed (the
+      ``_probe_out`` token, taken and released under the breaker lock);
+      concurrent half-open callers lose the race and are SHED — they
+      see the breaker as effectively open, they do not all probe at
+      once.  Probe success re-closes, failure re-opens (and restarts
+      the reset clock).  The probe token carries a LEASE: a probe whose
+      caller vanished without ever recording an outcome (crashed
+      thread, dropped future) expires after another ``reset_secs``, so
+      a lost probe degrades into one more probe-sized delay instead of
+      wedging the breaker half-open (shedding everything) forever.
 
     Thread-safe; ``clock`` is injectable for deterministic tests.  The
     obs gauge ``resilience/<name>/breaker_state`` exports 0=closed,
@@ -221,6 +229,7 @@ class CircuitBreaker:
         self._failures = 0  # consecutive, in CLOSED
         self._opened_at = 0.0
         self._probe_out = False  # a HALF_OPEN probe is in flight
+        self._probe_at = 0.0  # when the in-flight probe was granted
         reg = registry if registry is not None else obs.registry()
         self._registry = reg
         self._g_state = reg.gauge(f"resilience/{name}/breaker_state")
@@ -246,14 +255,25 @@ class CircuitBreaker:
 
     def allow(self) -> bool:
         """True if a call may proceed now.  In HALF_OPEN exactly one
-        in-flight probe is allowed; concurrent callers are shed."""
+        in-flight probe is allowed; concurrent callers are shed (they
+        must see the breaker as open, not all probe at once).  A probe
+        whose caller never reported an outcome expires after
+        ``reset_secs`` and its slot re-grants."""
         with self._lock:
             self._maybe_half_open()
             if self._state == self.CLOSED:
                 return True
-            if self._state == self.HALF_OPEN and not self._probe_out:
-                self._probe_out = True
-                return True
+            if self._state == self.HALF_OPEN:
+                if (self._probe_out
+                        and self._clock() - self._probe_at >= self.reset_secs):
+                    # the lease expired: the probe's caller died without
+                    # recording success/failure — presume it lost and
+                    # hand the (single) probe slot to this caller
+                    self._probe_out = False
+                if not self._probe_out:
+                    self._probe_out = True
+                    self._probe_at = self._clock()
+                    return True
             self._c_shed.inc()
             return False
 

@@ -25,6 +25,12 @@ Layer map:
     beam/greedy/spec/draft, SERVING.md "Quality tiers") with
     per-request deadline re-tiering, between-batch checkpoint
     hot-swap, full obs instrumentation.
+  * ``router``/``fleet`` — the elastic fleet (ISSUE 13, SERVING.md
+    "Elastic fleet"): ``ReplicaHandle`` rotation state + least-loaded
+    ``pick_replica`` (``router``), and the ``FleetRouter`` fronting N
+    replicas — health-aware routing, request hedging, rolling hot-swap,
+    chaos-tested replica failover with typed requeue (``fleet``;
+    jax-free).
 
 ``serve.queue``/``serve.batcher`` never import jax; ``serve.server``
 defers the decoder import until it actually builds one, so admission
@@ -34,6 +40,7 @@ and batching logic stay testable (and chaos-drivable) without a device.
 from __future__ import annotations
 
 from textsummarization_on_flink_tpu.serve.errors import (
+    ReplicaKilledError,
     ServeClosedError,
     ServeError,
     ServeOverloadError,
@@ -50,17 +57,22 @@ from textsummarization_on_flink_tpu.serve.batcher import (
 )
 
 __all__ = [
-    "ContinuousBatcher", "MicroBatcher", "RequestQueue", "ServeClosedError",
-    "ServeError", "ServeFuture", "ServeOverloadError", "ServeRequest",
-    "ServingServer", "resolve_buckets",
+    "ContinuousBatcher", "FleetRouter", "MicroBatcher", "ReplicaKilledError",
+    "RequestQueue", "ServeClosedError", "ServeError", "ServeFuture",
+    "ServeOverloadError", "ServeRequest", "ServingServer", "resolve_buckets",
 ]
 
 
 def __getattr__(name: str):
-    # ServingServer lazily: serve.server imports pipeline.io (sockets,
-    # breakers) which light importers of this package don't need
+    # ServingServer/FleetRouter lazily: serve.server imports pipeline.io
+    # (sockets, breakers) which light importers of this package don't
+    # need, and serve.fleet imports serve.server's error surface
     if name == "ServingServer":
         from textsummarization_on_flink_tpu.serve.server import ServingServer
 
         return ServingServer
+    if name == "FleetRouter":
+        from textsummarization_on_flink_tpu.serve.fleet import FleetRouter
+
+        return FleetRouter
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

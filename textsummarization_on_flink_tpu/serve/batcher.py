@@ -73,6 +73,15 @@ class MicroBatcher:
                              hps.batch_size)
         self._window = max(hps.serve_max_wait_ms, 0.0) / 1000.0
         self.buckets = resolve_buckets(hps)
+        #: requests popped off the queue into the group currently being
+        #: coalesced or dispatched (ISSUE 13): from the moment
+        #: next_group takes its first request until the server's
+        #: dispatch loop calls end_group, these are ADMITTED work that
+        #: the queue no longer shows — the fleet's idle()/load()
+        #: surfaces must see them or a rolling swap could fire
+        #: mid-coalesce.  Single writer (the dispatch thread); readers
+        #: only need zero/non-zero.
+        self.in_flight = 0
         reg = registry if registry is not None else obs.registry_for(hps)
         # fill is the headline batching metric: mean fill ~1 means the
         # window is too short (or traffic too thin) and every dispatch
@@ -103,6 +112,7 @@ class MicroBatcher:
         if first is None:
             return None
         group = [first]
+        self.in_flight = 1
         window_ends = time.monotonic() + self._window
         while len(group) < self.max_batch:
             remaining = window_ends - time.monotonic()
@@ -114,12 +124,19 @@ class MicroBatcher:
                     if req is None:
                         break
                     group.append(req)
+                    self.in_flight = len(group)
                 break
             req = self._q.get(timeout=remaining)
             if req is None:
                 break
             group.append(req)
+            self.in_flight = len(group)
         return group
+
+    def end_group(self) -> None:
+        """The dispatch loop finished the current group (every member's
+        future resolved or rejected): the in-flight window closes."""
+        self.in_flight = 0
 
     def build(self, group: List[ServeRequest]) -> Batch:
         """Pack a group into one static-shape Batch: encoder axis padded
@@ -205,6 +222,12 @@ class ContinuousBatcher:
         reg = registry if registry is not None else obs.registry_for(hps)
         self._reg = reg
         self._g_active = reg.gauge("serve/slots_active")
+        # the /healthz-scrapeable routing input (ISSUE 13): the
+        # FleetRouter's least-loaded pick wants free capacity, and
+        # slots - slots_active is not derivable from gauges alone (the
+        # slot COUNT is construction state, not a metric)
+        self._g_free = reg.gauge("serve/slots_free")
+        self._g_free.set(self.slots)
         # occupancy is the headline continuous metric: fraction of slots
         # doing useful work at each chunk step (mean ~1 under load means
         # refill keeps up; the microbatch analogue is fill/batch_size)
@@ -238,6 +261,17 @@ class ContinuousBatcher:
     def busy(self) -> bool:
         return any(r is not None for r in self._resident)
 
+    def active(self) -> int:
+        """Resident (occupied) slot count right now — the FleetRouter's
+        load input alongside the queue depth."""
+        return sum(r is not None for r in self._resident)
+
+    def prefilled(self) -> int:
+        """Prefilled-but-unslotted request count (admitted work that is
+        neither queued nor resident — the router's load math must not
+        lose it)."""
+        return len(self._prefilled)
+
     def pending(self) -> bool:
         """True while prefilled-but-unslotted requests await a slot —
         part of the drain condition: a tick can harvest EVERY resident
@@ -247,7 +281,9 @@ class ContinuousBatcher:
         return bool(self._prefilled)
 
     def _set_active_gauge(self) -> None:
-        self._g_active.set(sum(r is not None for r in self._resident))
+        n = sum(r is not None for r in self._resident)
+        self._g_active.set(n)
+        self._g_free.set(self.slots - n)
 
     def _evict_expired(self) -> None:
         """Resident requests whose enqueue-measured Deadline ran out are

@@ -12,6 +12,12 @@ them) keep working:
   * ``ServeClosedError`` — the server is stopping/stopped; submissions
     are refused and any request still queued at hard-stop is rejected
     with this.
+  * ``ReplicaKilledError`` — the replica serving this request died
+    mid-decode (chaos ``serve.replica_kill``, or a real crash surfaced
+    through ``ServingServer.kill``).  The FleetRouter routes on exactly
+    this type: a killed replica's residents and queued requests
+    re-enqueue on survivors (SERVING.md "Elastic fleet"), so a caller
+    only ever sees it when the whole fleet is gone.
 
 Import-light by design (no jax/numpy): callers catch these in
 admission paths that must stay cheap.
@@ -33,3 +39,8 @@ class ServeOverloadError(ServeError):
 
 class ServeClosedError(ServeError):
     """The serving server is stopped (or stopping); no new requests."""
+
+
+class ReplicaKilledError(ServeError):
+    """The replica holding this request died mid-decode; the request is
+    requeue-eligible (the FleetRouter re-enqueues it on a survivor)."""

@@ -52,12 +52,19 @@ class ServeFuture:
     and for sinks that must see one row per request.
     """
 
-    __slots__ = ("uuid", "trace", "_event", "_result", "_error", "_lock",
-                 "_callbacks", "_registry")
+    __slots__ = ("uuid", "trace", "scope", "_event", "_result", "_error",
+                 "_lock", "_callbacks", "_registry")
 
     def __init__(self, uuid: str = "",
                  registry: Optional[obs.Registry] = None):
         self.uuid = uuid
+        # resolve-event scope tag (ISSUE 13): "" for replica-level
+        # futures; the FleetRouter stamps its caller-visible future
+        # "fleet" so a hedged/requeued uuid's TERMINAL resolve is
+        # distinguishable from its replica attempts' resolves in the
+        # event stream (scripts/trace_summary.py --request keys the
+        # total_ms phase on it)
+        self.scope = ""
         # the request's TraceContext (set by ServeRequest): resolution
         # is the terminal lifecycle event of a trace, and it can happen
         # on any thread — the dispatcher, an evictor, drain_reject —
@@ -118,8 +125,10 @@ class ServeFuture:
             # a waiter unblocked by result() must find the resolve
             # record already in the stream (emit is a non-blocking
             # queue put — cheap under the lock).
-            attrs = ({"error": type(error).__name__}
-                     if error is not None else {})
+            attrs: dict = ({"error": type(error).__name__}
+                           if error is not None else {})
+            if self.scope:
+                attrs["scope"] = self.scope
             obs.spans.request_event(self._registry, "resolve", self.trace,
                                     self.uuid, **attrs)
             self._event.set()
@@ -143,7 +152,7 @@ class ServeRequest:
     def __init__(self, uuid: str, article: str, reference: str,
                  example: Any, deadline: Optional[Deadline] = None,
                  registry: Optional[obs.Registry] = None,
-                 tier: str = ""):
+                 tier: str = "", trace: Optional[obs.TraceContext] = None):
         self.uuid = uuid
         self.article = article
         self.reference = reference
@@ -161,8 +170,15 @@ class ServeRequest:
         # job (obs=False / TS_OBS=0) skips the mint: every consumer
         # (request_event, span parent) discards the ids anyway, so the
         # submit hot path shouldn't pay the urandom read for them.
+        # An EXPLICIT ``trace`` wins over the mint (ISSUE 13): the
+        # FleetRouter mints ONE context per routed request and threads
+        # it through every replica attempt (primary, hedge, requeue),
+        # so a request's cross-replica lifecycle shares one trace_id.
         reg = registry if registry is not None else obs.registry()
-        self.trace = obs.TraceContext.new() if reg.enabled else None
+        if trace is not None:
+            self.trace = trace
+        else:
+            self.trace = obs.TraceContext.new() if reg.enabled else None
         self.future.trace = self.trace
         # the budget runs from ENQUEUE: queue wait spends it, so a
         # request that aged in a deep queue reaches the decoder with

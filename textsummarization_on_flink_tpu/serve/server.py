@@ -109,6 +109,7 @@ from textsummarization_on_flink_tpu.serve.batcher import (
     MicroBatcher,
 )
 from textsummarization_on_flink_tpu.serve.errors import (
+    ReplicaKilledError,
     ServeClosedError,
     ServeOverloadError,
 )
@@ -176,6 +177,14 @@ class ServingServer:
                                          registry=self._reg)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._killed = False  # abrupt death (kill()): no drain, no refill
+        # micro-batch groups currently inside decode_batch (0 or 1 —
+        # single dispatch thread): the router's drain detection must
+        # not call a server idle while a group is mid-dispatch
+        self._dispatching = 0
+        # deterministic-driver clock for tick_once (the fleet SLO gate
+        # drives rounds without a dispatch thread)
+        self._tick_last = time.monotonic()
         # failure flight recorder (OBSERVABILITY.md "Flight recorder"):
         # per-tick/per-dispatch frames ring in memory; the serve-side
         # triggers (dispatch failure, breaker open, eviction storm) dump
@@ -193,6 +202,11 @@ class ServingServer:
         # live exposition plane (/metrics, /healthz, /snapshot, /spans):
         # off unless TS_OBS_HTTP / HParams(obs_http_port) says otherwise
         obs_http.maybe_serve(self._reg, hps)
+        # the router's routing inputs ride /healthz (ISSUE 13): the
+        # effective serve_mode joins the queue-depth/slots-free gauges
+        # in the JSON body, so an external router scrapes the same
+        # facts the in-process FleetRouter reads off stats()
+        obs_http.set_health_info(self._reg, serve_mode=self._mode)
         self._h_queue_time = self._reg.histogram(
             "serve/time_in_queue_seconds")
         self._h_e2e = self._reg.histogram("serve/e2e_latency_seconds")
@@ -219,6 +233,8 @@ class ServingServer:
 
     # -- lifecycle --
     def start(self) -> "ServingServer":
+        if self._killed:
+            raise ServeClosedError("cannot start a killed replica")
         if self._thread is not None:
             return self
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -256,6 +272,130 @@ class ServingServer:
         # so /healthz reflects the components still running
         obs_http.retire_heartbeat(self._reg, "serve/dispatch")
 
+    def kill(self, error: Optional[BaseException] = None) -> int:
+        """Simulate (or surface) abrupt replica death: refuse new
+        submits, abandon the dispatch thread WITHOUT draining, and
+        reject every admitted request — residents and prefill-queue
+        entries through the typed ``fail_resident``/``fail_pending``
+        path, queued requests via ``drain_reject`` — with
+        ``ReplicaKilledError`` (or `error`).  Returns the number of
+        requests rejected.
+
+        The exactly-once contract survives death: every rejected future
+        resolves exactly once with the typed cause, which is what lets
+        the FleetRouter requeue them on surviving replicas (SERVING.md
+        "Elastic fleet").  Idempotent; a clean ``stop()`` is the
+        graceful sibling."""
+        if self._killed:
+            return 0
+        err = error if error is not None else ReplicaKilledError(
+            "serving replica killed mid-decode")
+        self._killed = True
+        self._queue.close()
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            # the dispatch thread exits at its next loop-top _killed
+            # check; join BEFORE failing residents so the kill path
+            # never races a live tick over the engine state
+            t.join(timeout=30.0)
+            if t.is_alive():  # pragma: no cover - defensive
+                log.warning("killed serve dispatch thread still inside a "
+                            "dispatch; residents will fail under it")
+            self._thread = None
+        n = 0
+        if self._cont is not None:
+            n += self._cont.fail_resident(err)
+            n += self._cont.fail_pending(err)
+        drained = self._queue.drain_reject(err)
+        if drained:
+            self._c_errors.inc(drained)
+        n += drained
+        obs_http.retire_heartbeat(self._reg, "serve/dispatch")
+        if n:
+            log.warning("replica killed: %d admitted request(s) rejected "
+                        "%s for requeue", n, type(err).__name__)
+        return n
+
+    @property
+    def killed(self) -> bool:
+        return self._killed
+
+    def hot_swap(self) -> bool:
+        """Router-orchestrated FORCED checkpoint swap (SERVING.md
+        "Elastic fleet"): reload the newest checkpoint NOW — no 60s
+        self-gate — between dispatches, while the router holds this
+        replica drained.  Same failure tolerance as the between-batch
+        path: a failed reload keeps the replica serving its CURRENT
+        snapshot (counted in ``serve/ckpt_reload_errors_total``) and
+        returns False; the router keeps it in rotation either way."""
+        try:
+            # -inf forces the cadence check; the decoder's params lock
+            # still makes the (params, ckpt, draft) swap atomic
+            self._decoder.maybe_reload_checkpoint(float("-inf"))
+            return True
+        except Exception:
+            self._reg.counter("serve/ckpt_reload_errors_total").inc()
+            log.exception("router-orchestrated hot-swap failed; serving "
+                          "on the current snapshot")
+            return False
+
+    def idle(self) -> bool:
+        """True when the server holds NO admitted work: queue empty, no
+        group coalescing or mid-dispatch (the micro-batcher pops
+        requests off the queue up to ``serve_max_wait_ms`` before the
+        dispatch starts — those are admitted work the queue no longer
+        shows), no residents, no prefilled entries — the router's
+        drained predicate for rolling hot-swap."""
+        if not self._queue.empty() or self._dispatching:
+            return False
+        if self._batcher is not None and self._batcher.in_flight:
+            return False
+        if self._cont is not None and (self._cont.busy()
+                                       or self._cont.pending()):
+            return False
+        return True
+
+    def stats(self) -> dict:
+        """Live routing inputs (the in-process mirror of the /healthz
+        body's ``serve`` section): queue depth, resident/free slots
+        (continuous), prefilled count, effective serve_mode, and the
+        LIVE admission-breaker state (the ``breaker_state`` gauge only
+        refreshes on allow(), so a scraped OPEN may already be past its
+        reset window — the state property re-evaluates)."""
+        out = {
+            "queue_depth": self._queue.qsize(),
+            "serve_mode": self._mode,
+            "admission": self._queue.breaker.state,
+        }
+        if self._cont is not None:
+            active = self._cont.active()
+            out["slots_active"] = active
+            out["slots_free"] = self._cont.slots - active
+            out["prefilled"] = self._cont.prefilled()
+        return out
+
+    def load(self) -> int:
+        """Admitted-but-unresolved work count — the FleetRouter's
+        least-loaded routing key (queued + coalescing/dispatching +
+        resident + prefilled)."""
+        n = self._queue.qsize()
+        if self._batcher is not None:
+            n += self._batcher.in_flight
+        if self._cont is not None:
+            n += self._cont.active() + self._cont.prefilled()
+        return n
+
+    @property
+    def registry(self) -> obs.Registry:
+        """This replica's obs registry — the router reads its /healthz
+        payload (heartbeat staleness, breaker states) through it."""
+        return self._reg
+
+    @property
+    def serve_mode(self) -> str:
+        return self._mode
+
     def __enter__(self) -> "ServingServer":
         return self.start()
 
@@ -265,7 +405,8 @@ class ServingServer:
     # -- request API --
     def submit(self, article: str, uuid: str = "", reference: str = "",
                block: bool = False, timeout: Optional[float] = None,
-               tier: str = "") -> ServeFuture:
+               tier: str = "",
+               trace: Optional[obs.TraceContext] = None) -> ServeFuture:
         """Admit one request; returns its future.
 
         Non-blocking (default): full queue / open admission breaker
@@ -283,7 +424,12 @@ class ServingServer:
 
         The per-request Deadline starts NOW (enqueue), so queue wait
         spends the ``decode_deadline_secs`` budget and an aged request
-        degrades to greedy exactly like a slow one (RESILIENCE.md)."""
+        degrades to greedy exactly like a slow one (RESILIENCE.md).
+
+        ``trace`` injects an externally-minted TraceContext (the
+        FleetRouter threads ONE context through every replica attempt
+        of a routed request, SERVING.md "Elastic fleet"); None mints a
+        fresh per-request root, the pre-fleet behavior."""
         tier = tier or getattr(self._hps, "serve_default_tier", "beam")
         if tier not in SERVE_TIERS:
             raise ValueError(
@@ -311,7 +457,7 @@ class ServingServer:
             uuid, article, reference, example,
             deadline=Deadline.after(
                 getattr(self._hps, "decode_deadline_secs", 0.0)),
-            registry=self._reg, tier=tier)
+            registry=self._reg, tier=tier, trace=trace)
         self._queue.submit(req, block=block, timeout=timeout)
         return req.future
 
@@ -401,13 +547,23 @@ class ServingServer:
             return
         t_last = time.monotonic()
         while True:
+            if self._killed:
+                return  # abrupt death: no drain (kill() rejects leftovers)
             self._beat()
             group = self._batcher.next_group()
             if group is None:
                 if self._stop.is_set() and self._queue.empty():
                     return
                 continue
-            self._dispatch(group)
+            self._dispatching += 1
+            try:
+                self._dispatch(group)
+            finally:
+                self._dispatching -= 1
+                # the group's futures are all settled: the coalesce/
+                # dispatch in-flight window (opened inside next_group)
+                # closes — idle()/load() stop counting it
+                self._batcher.end_group()
             if self._stop.is_set() and self._queue.empty():
                 return
             try:
@@ -425,25 +581,44 @@ class ServingServer:
                               "continuing on current params")
                 t_last = time.monotonic()
 
+    def _continuous_round(self, t_last: float, poll: float = 0.05) -> float:
+        """ONE continuous-mode scheduler round (beat -> tick -> between-
+        chunk hot-swap), shared by the dispatch thread's loop and the
+        deterministic ``tick_once`` driver so the two can never drift.
+        A failed tick — injected serve.dispatch fault, engine error —
+        fails the RESIDENT requests only (each resolves exactly once
+        with the typed cause) and the round returns normally, mirroring
+        the micro-batch 'a failed dispatch fails its batch only'
+        contract at slot granularity."""
+        self._beat()
+        try:
+            self._cont.tick(poll)
+        except Exception as e:  # tslint: disable=TS005 — every resident future is rejected with the typed cause and counted in serve/errors_total by fail_resident; the loop must outlive any one tick
+            flightrec.trigger(self._reg, "serve_dispatch",
+                              error=type(e).__name__)
+            n = self._cont.fail_resident(e)
+            log.exception("continuous dispatch tick failed; rejected "
+                          "%d resident request(s)", n)
+        try:
+            # same hot-swap cadence as the micro-batch loop (the
+            # decoder self-gates at 60s); a resident article picks
+            # up new params at its next chunk boundary (SERVING.md)
+            return self._decoder.maybe_reload_checkpoint(t_last)
+        except Exception:
+            self._reg.counter("serve/ckpt_reload_errors_total").inc()
+            log.exception("between-chunk checkpoint reload failed; "
+                          "continuing on current params")
+            return time.monotonic()
+
     def _run_continuous(self) -> None:
-        """The continuous-mode dispatch loop: drive the ContinuousBatcher
-        scheduler (evict -> refill -> chunk step -> harvest) until
-        stopped AND drained.  A failed tick — injected serve.dispatch
-        fault, engine error — fails the RESIDENT requests only (each
-        resolves exactly once with the typed cause) and the loop lives
-        on, mirroring the micro-batch 'a failed dispatch fails its batch
-        only' contract at slot granularity."""
+        """The continuous-mode dispatch loop: drive scheduler rounds
+        until stopped AND drained (or killed — abrupt death skips the
+        drain; kill() resolves the leftovers typed)."""
         t_last = time.monotonic()
         while True:
-            self._beat()
-            try:
-                self._cont.tick()
-            except Exception as e:  # tslint: disable=TS005 — every resident future is rejected with the typed cause and counted in serve/errors_total by fail_resident; the loop must outlive any one tick
-                flightrec.trigger(self._reg, "serve_dispatch",
-                                  error=type(e).__name__)
-                n = self._cont.fail_resident(e)
-                log.exception("continuous dispatch tick failed; rejected "
-                              "%d resident request(s)", n)
+            if self._killed:
+                return
+            t_last = self._continuous_round(t_last)
             # drain condition: queue empty AND no residents AND no
             # prefilled-but-unslotted requests (a tick can harvest every
             # resident right after the prefill stage drained the
@@ -453,16 +628,22 @@ class ServingServer:
                     and not self._cont.busy()
                     and not self._cont.pending()):
                 return
-            try:
-                # same hot-swap cadence as the micro-batch loop (the
-                # decoder self-gates at 60s); a resident article picks
-                # up new params at its next chunk boundary (SERVING.md)
-                t_last = self._decoder.maybe_reload_checkpoint(t_last)
-            except Exception:
-                self._reg.counter("serve/ckpt_reload_errors_total").inc()
-                log.exception("between-chunk checkpoint reload failed; "
-                              "continuing on current params")
-                t_last = time.monotonic()
+
+    def tick_once(self, poll: float = 0.0) -> None:
+        """One continuous-mode scheduler round on the CALLER's thread.
+
+        The deterministic-driver hook (SERVING.md "Elastic fleet"): the
+        fleet virtual-time SLO gate and single-threaded harnesses drive
+        the REAL dispatch path — the exact code the dispatch thread
+        runs, including the tick-failure blast radius and the
+        between-chunk hot-swap — one round at a time, with no threads
+        and no sleeps.  Never call concurrently with a started
+        dispatch thread (single consumer, like the thread itself)."""
+        if self._cont is None:
+            raise ValueError(
+                "tick_once drives the continuous engine; this server is "
+                f"serve_mode={self._mode!r} — start() it instead")
+        self._tick_last = self._continuous_round(self._tick_last, poll)
 
     #: deadline-pressure re-tiering per REQUESTED tier: beam falls to
     #: the configured target, spec falls to its verify-free draft;

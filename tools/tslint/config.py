@@ -95,6 +95,14 @@ DEFAULT: Dict[str, Any] = {
                 r"^DistillTrainer\._distill_steps$",
                 r"^run_spec_decode_adaptive$",
                 r"^SpecKController\.(observe|update)$",
+                # the elastic fleet's router loops (ISSUE 13): tick runs
+                # on every router round, the hedge scan walks every
+                # in-flight request, and the swap step gates each
+                # replica's drain — a host sync in any of them stalls
+                # routing (and hedging timing) for the whole fleet
+                r"^FleetRouter\.(tick|_hedge_scan|_swap_step"
+                r"|_maybe_chaos_kill)$",
+                r"^ServingServer\.(_continuous_round|tick_once)$",
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
