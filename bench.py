@@ -63,7 +63,17 @@ Modes (BENCH_MODE):
                     the load through the ISSUE-13 FleetRouter over N
                     in-process replicas — fleet rows carry hedge
                     spend/wins and requeue counts (SERVING.md "Elastic
-                    fleet") and fingerprint their topology.
+                    fleet") and fingerprint their topology;
+                    `--serve-zipf=S` (BENCH_SERVE_ZIPF) draws requests
+                    zipf-distributed (p(k) ~ 1/(k+1)^S) over a pool of
+                    distinct articles and arms the ISSUE-14 front door
+                    (coalescing + the summary cache, capacity
+                    BENCH_SERVE_CACHE) — the heavy-tailed trending-
+                    article workload (SERVING.md "Front door");
+                    fingerprint axis only when non-default.  Every
+                    serve row carries `cache_hit_rate`,
+                    `coalesced_total`, and `decodes_per_submit` (1.0
+                    with the door dark — each submit decodes).
   bytes           — XLA cost-analysis byte accounting for the train
                     step (no execution; CPU-forced like input mode):
                     bytes accessed + intensity for the baseline config
@@ -409,6 +419,15 @@ def _config_fingerprint() -> dict:
                 float(os.environ.get("BENCH_SERVE_SHORT_RATIO", "0.75")))
             if sr != 0.75:
                 fp["short_ratio"] = sr
+        # front-door axis (ISSUE 14): a zipf mix with the door armed
+        # does fundamentally less work than a uniform mix (coalesced
+        # followers and cache hits never decode) — zipf rows must never
+        # stand in for non-zipf rows.  Non-default only, house
+        # convention; the cache capacity rides along because a smaller
+        # cache means more re-decodes under the same S.
+        if float(os.environ.get("BENCH_SERVE_ZIPF", "0") or 0) > 0:
+            fp["zipf"] = float(os.environ["BENCH_SERVE_ZIPF"])
+            fp["cache"] = int(os.environ.get("BENCH_SERVE_CACHE", "256"))
         # elastic-fleet axis (ISSUE 13): N routed replicas run a
         # DIFFERENT serving topology than one server (router hop,
         # hedging, per-replica queues) — fleet rows must never stand in
@@ -1402,11 +1421,23 @@ def bench_serve() -> None:
     refill_chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "0"))
     replicas_n = int(os.environ.get("BENCH_SERVE_REPLICAS", "1"))
     hedge_ms = float(os.environ.get("BENCH_SERVE_HEDGE_MS", "0"))
+    # the ISSUE-14 front door: a zipf exponent > 0 draws the request
+    # stream heavy-tailed over a pool of DISTINCT articles and arms
+    # coalescing + the summary cache (capacity BENCH_SERVE_CACHE) —
+    # the duplicate-heavy trending-article workload
+    zipf_s = float(os.environ.get("BENCH_SERVE_ZIPF", "0") or 0)
+    if zipf_s < 0:
+        raise ValueError(
+            f"BENCH_SERVE_ZIPF must be >= 0 (0 = off), got {zipf_s}")
+    cache_entries = int(os.environ.get("BENCH_SERVE_CACHE", "256")) \
+        if zipf_s > 0 else 0
     hps = HParams(batch_size=batch, mode="decode", coverage=True,
                   serve_max_wait_ms=wait_ms, serve_mode=serve_mode,
                   serve_slots=slots, serve_refill_chunk=refill_chunk,
                   serve_max_queue=max(256, reqs),
                   serve_replicas=replicas_n, serve_hedge_ms=hedge_ms,
+                  serve_coalesce=zipf_s > 0,
+                  serve_cache_entries=cache_entries,
                   **_preset_overrides())
     if tier in ("spec", "draft"):
         # the draft model source: the mapped bootstrap for the
@@ -1468,6 +1499,15 @@ def bench_serve() -> None:
             limit = buckets[i % len(buckets)]
             n = rng.randint(max(limit // 2, 1), limit + 1)
             articles.append(" ".join(rng.choice(pool, size=n)))
+    # zipf request ORDER over whichever article pool the mix built:
+    # p(k) ~ 1/(k+1)^S, seeded — the same heavy-tailed draw as the
+    # SERVE_SLO.json front_door gate, at bench scale
+    zipf_order = None
+    if zipf_s > 0:
+        weights = np.array([1.0 / (k + 1) ** zipf_s
+                            for k in range(len(articles))])
+        zipf_order = rng.choice(len(articles), size=reqs,
+                                p=weights / weights.sum())
     family = get_family(hps.model_family)
     params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(0))
     params = _stop_biased(params, hps.vocab_size,
@@ -1536,6 +1576,14 @@ def bench_serve() -> None:
             accepted0 = reg.counter(
                 "decode/spec_accepted_tokens_total").value
             cycles0 = reg.counter("decode/spec_cycles_total").value
+            # front-door accounting (ISSUE 14): completed counts only
+            # requests that actually DECODED (cache hits resolve at
+            # submit, followers from their leader), so decodes/submit
+            # is the redundant-work ratio the zipf row exists to show
+            completed0 = reg.counter("serve/completed_total").value
+            hits0 = reg.counter("serve/cache_hits_total").value
+            misses0 = reg.counter("serve/cache_misses_total").value
+            coalesced0 = reg.counter("serve/coalesced_total").value
             lat: list = []
             # trace-derived per-request breakdown (ISSUE 9 satellite):
             # TEE the timed phase's lifecycle events into memory (an
@@ -1555,8 +1603,10 @@ def bench_serve() -> None:
                     return ok
 
             def one(i: int) -> None:
+                art = articles[int(zipf_order[i])] if zipf_order \
+                    is not None else articles[i % len(articles)]
                 t0 = time.perf_counter()
-                server.submit(articles[i % len(articles)], uuid=f"r{i}",
+                server.submit(art, uuid=f"r{i}",
                               block=True, tier=tier).result(timeout=1200)
                 lat.append(time.perf_counter() - t0)
 
@@ -1657,6 +1707,20 @@ def bench_serve() -> None:
             "shed_total": int(reg.counter("serve/shed_total").value - shed0),
             "degraded_total": int(
                 reg.counter("serve/degraded_total").value - degraded0),
+            # front-door row fields (ISSUE 14): present on every serve
+            # row — a dark door reads hit_rate 0, coalesced 0,
+            # decodes_per_submit 1.0 (every submit decoded)
+            "cache_hit_rate": round(
+                (reg.counter("serve/cache_hits_total").value - hits0)
+                / max(1.0, (reg.counter("serve/cache_hits_total").value
+                            - hits0)
+                      + (reg.counter("serve/cache_misses_total").value
+                         - misses0)), 4),
+            "coalesced_total": int(
+                reg.counter("serve/coalesced_total").value - coalesced0),
+            "decodes_per_submit": round(
+                (reg.counter("serve/completed_total").value - completed0)
+                / reqs, 4),
             "model_family": hps.model_family,
             "spec_k": int(hps.spec_k),
             "timing": "wall-clock per request, enqueue -> resolved future "
@@ -1986,6 +2050,9 @@ if __name__ == "__main__":
         elif arg.startswith("--serve-hedge-ms="):
             os.environ["BENCH_MODE"] = "serve"
             os.environ["BENCH_SERVE_HEDGE_MS"] = arg.split("=", 1)[1]
+        elif arg.startswith("--serve-zipf="):
+            os.environ["BENCH_MODE"] = "serve"
+            os.environ["BENCH_SERVE_ZIPF"] = arg.split("=", 1)[1]
     if os.environ.get("TS_BENCH_CHILD") == "1":
         child_main()
     else:

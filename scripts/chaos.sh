@@ -219,4 +219,49 @@ print(f"serve.replica_kill OK: 1 injected replica death, {requeued} "
 PY
 
 echo
+echo "== TS_FAULTS sweep: serve.cache_fault (front door degrades to miss)"
+TS_FAULTS="serve.cache_fault:1.0:0" python - <<'PY'
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.decode.decoder import DecodedResult
+from textsummarization_on_flink_tpu.resilience import faultinject
+from textsummarization_on_flink_tpu.serve.server import ServingServer
+
+class EchoDecoder:
+    """Content-deterministic stub: the cache CONTRACT (never a wrong
+    summary, never a hung future) is host-side, no device needed."""
+    def should_degrade(self, deadline):
+        return False
+    def decode_batch(self, batch, deadline=None, tier=None):
+        return [DecodedResult(
+                    uuid=batch.uuids[b], article=batch.original_articles[b],
+                    decoded_words=batch.original_articles[b].split()[:3],
+                    reference=batch.references[b], abstract_sents=[])
+                for b in range(len(batch.uuids)) if batch.real_mask[b]]
+    def maybe_reload_checkpoint(self, last):
+        return last
+
+vocab = Vocab(words=["the", "cat", "sat", "."])
+hps = HParams(mode="decode", batch_size=2, vocab_size=vocab.size(),
+              max_enc_steps=8, max_dec_steps=4, beam_size=2,
+              min_dec_steps=1, max_oov_buckets=4, serve_max_queue=16,
+              serve_cache_entries=8)
+with ServingServer(hps, vocab, decoder=EchoDecoder()) as server:
+    r1 = server.submit("the cat sat .", uuid="u1").result(timeout=30)
+    r2 = server.submit("the cat sat .", uuid="u2").result(timeout=30)
+reg = obs.registry()
+fires = faultinject.plan().stats()["serve.cache_fault"]["fires"]
+hits = int(reg.counter("serve/cache_hits_total").value)
+errors = int(reg.counter("serve/cache_errors_total").value)
+decodes = int(reg.counter("serve/completed_total").value)
+assert r1.summary == r2.summary, (r1.summary, r2.summary)
+assert hits == 0 and decodes == 2, (hits, decodes)
+assert fires >= 2 and errors >= 2, (fires, errors)
+print(f"serve.cache_fault OK: {fires} injected cache faults degraded to "
+      f"miss-and-decode ({decodes} decodes, 0 hits), summaries identical, "
+      f"every future resolved")
+PY
+
+echo
 echo "chaos OK"

@@ -77,6 +77,14 @@ echo "== fleet smoke (3 replicas, kill one under load, exactly-once + parity)"
 # enforced in the suite above)
 python scripts/fleet_smoke.py
 
+echo "== front-door smoke (coalescing + summary cache on a real model)"
+# the ISSUE-14 front door end to end: a duplicate-heavy burst coalesces
+# onto shared decodes, the warm pass serves byte-identical rows from
+# the (content_hash, tier, fingerprint) cache with zero new decodes,
+# and the tier axis misses as designed (the enforced zipf/tenant/fleet
+# scheduling claims live in SERVE_SLO.json front_door, in the suite)
+python scripts/front_door_smoke.py
+
 echo "== speculative-tier smoke (draft init -> spec decode -> exactness)"
 # the ISSUE-10 fast path end to end: AAN draft mapped from the full
 # model's own params, draft-then-verify decode through the decoder's

@@ -241,8 +241,16 @@ def request_timeline(paths, uuid: str) -> dict:
             phases["resident_ms"] = _ms("admit", "resolve")
     if "finish" in first and "resolve" in first:
         phases["resolve_ms"] = _ms("finish", "resolve")
-    if "enqueue" in first and "resolve" in first:
-        phases["total_ms"] = _ms("enqueue", "resolve")
+    # a request's timeline ROOT is its first lifecycle event: enqueue
+    # for a queued request, else coalesced (a follower attached to an
+    # in-flight leader) or cache_hit (resolved synchronously at submit)
+    # — the ISSUE-14 front-door paths never enqueue (SERVING.md "Front
+    # door"), but their coalesced/cache_hit -> resolve window is still
+    # the caller-observed total
+    root = next((e for e in ("enqueue", "coalesced", "cache_hit")
+                 if e in first), None)
+    if root is not None and "resolve" in first:
+        phases["total_ms"] = _ms(root, "resolve")
     return {"uuid": uuid, "trace_id": trace_id, "events": events,
             "spans": spans, "phases": phases,
             "trace_ids": sorted(trace_ids)}

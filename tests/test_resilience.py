@@ -329,7 +329,7 @@ class TestFaultSpecs:
         assert set(faultinject.KNOWN_POINTS) == {
             "io.connect", "io.read", "io.write",
             "ckpt.load", "train.step_nan", "etl.worker",
-            "serve.dispatch", "serve.replica_kill"}
+            "serve.dispatch", "serve.replica_kill", "serve.cache_fault"}
 
 
 class TestFaultPlan:

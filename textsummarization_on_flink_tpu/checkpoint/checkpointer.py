@@ -144,6 +144,25 @@ def arrays_to_state(flat: Dict[str, np.ndarray]) -> TrainState:
 # Raw file IO
 # --------------------------------------------------------------------------
 
+def content_fingerprint(tree: PyTree) -> str:
+    """Content fingerprint of one params pytree: sha256 over every
+    leaf's bytes in deterministic (flattened-name) order, truncated to
+    16 hex chars.  Two trees collide only if they are byte-identical.
+
+    The ONE fingerprint scheme (ISSUE 12/14): the distillation
+    teacher sidecar (train/distill.teacher_fingerprint) and the serve
+    layer's summary-cache key (decode/decoder.params_fingerprint,
+    SERVING.md "Front door") both resolve through here, so the two can
+    never drift — a draft checkpoint verified against a teacher and a
+    cache entry keyed on a snapshot mean the same bytes."""
+    flat = _flatten(tree)
+    h = hashlib.sha256()
+    for name in sorted(flat):
+        h.update(name.encode("utf-8"))
+        h.update(np.ascontiguousarray(flat[name]).tobytes())
+    return h.hexdigest()[:16]
+
+
 def _file_sha256(path: str) -> Tuple[str, int]:
     h = hashlib.sha256()
     size = 0

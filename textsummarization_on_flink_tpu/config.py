@@ -338,6 +338,47 @@ class HParams:
     # counted in serve/hedge_suppressed_total and left to their
     # primary).  The committed gate value lives in SERVE_SLO.json.
     serve_hedge_max_ratio: float = 0.1
+    # ---- serving front door (SERVING.md "Front door"; ISSUE 14) ----
+    # Bounded LRU summary-cache capacity in ENTRIES, keyed on
+    # (content_hash, tier, params_fingerprint) — the fingerprint key is
+    # what makes checkpoint hot-swap invalidate correctly by
+    # construction (a swapped decoder reports a new fingerprint, so the
+    # old entries simply stop matching).  A hit resolves the future
+    # synchronously at submit without touching the queue, byte-identical
+    # to a fresh decode of the same (article, tier, fingerprint) —
+    # the pointer-generator's deterministic tiers are what make the
+    # reuse exact, not approximate.  0 (default) = cache off, today's
+    # behavior.
+    serve_cache_entries: int = 0
+    # Approximate byte ceiling for the summary cache (cached
+    # decoded-word payloads); evicts LRU-first once exceeded.  0 = no
+    # byte bound (the entry bound above still applies).
+    serve_cache_bytes: int = 0
+    # In-flight request coalescing: True attaches every submit whose
+    # (content_hash, tier) matches a resident computation to that ONE
+    # decode — all attached futures resolve exactly once from the
+    # leader's result (leader failure fails the attached futures typed;
+    # never hangs, never double-decodes).  False (default) keeps
+    # today's one-decode-per-submit behavior.
+    serve_coalesce: bool = False
+    # Per-tenant token-bucket admission rate in requests/second
+    # (ServeRequest.tenant; the default "" tenant is a tenant like any
+    # other).  A submit finding its tenant's bucket empty is shed with
+    # the typed TenantThrottledError BEFORE the queue/breaker — one
+    # tenant's burst spends its own bucket, not the fleet's queue.
+    # 0 (default) = unlimited, today's behavior.
+    serve_tenant_rate: float = 0.0
+    # Token-bucket burst depth (tokens a quiet tenant may accumulate).
+    # 0 = auto: max(1, ceil(serve_tenant_rate)) — about one second of
+    # burst (config.resolve_tenant_burst is the one resolver).
+    serve_tenant_burst: int = 0
+    # Weighted-fair queue pickup weights, "tenant:weight" comma-
+    # separated (e.g. "free:1,paid:4"); unlisted tenants weigh 1.0.
+    # The RequestQueue's consumer side picks across per-tenant FIFOs by
+    # smooth weighted round-robin, so one tenant's deep backlog cannot
+    # starve another's pickup.  "" = every tenant weighs 1.0 (and a
+    # single-tenant queue is exactly the historical FIFO).
+    serve_fair_weights: str = ""
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score
@@ -602,6 +643,25 @@ class HParams:
         if self.serve_replicas < 1:
             raise ValueError(
                 f"serve_replicas must be >= 1, got {self.serve_replicas}")
+        if self.serve_cache_entries < 0:
+            raise ValueError(
+                f"serve_cache_entries must be >= 0 (0 = cache off), got "
+                f"{self.serve_cache_entries}")
+        if self.serve_cache_bytes < 0:
+            raise ValueError(
+                f"serve_cache_bytes must be >= 0 (0 = no byte bound), "
+                f"got {self.serve_cache_bytes}")
+        if self.serve_tenant_rate < 0:
+            raise ValueError(
+                f"serve_tenant_rate must be >= 0 (0 = unlimited), got "
+                f"{self.serve_tenant_rate}")
+        if self.serve_tenant_burst < 0:
+            raise ValueError(
+                f"serve_tenant_burst must be >= 0 (0 = auto), got "
+                f"{self.serve_tenant_burst}")
+        # parse for validation only — a bad weights spec fails at config
+        # time, not at the first queue pickup
+        parse_fair_weights(self.serve_fair_weights)
         if self.serve_hedge_ms < 0:
             raise ValueError(
                 f"serve_hedge_ms must be >= 0 (0 = hedging off), got "
@@ -700,6 +760,57 @@ def parse_bucket_spec(spec: str, max_enc_steps: int) -> "List[int]":
         # the top bucket must cover every admissible article
         buckets.append(max_enc_steps)
     return buckets
+
+
+def parse_fair_weights(spec: str) -> "Dict[str, float]":
+    """Resolve ``serve_fair_weights`` to a {tenant: weight} dict
+    (SERVING.md "Front door").
+
+    The ONE parser: HParams.validate() and serve/queue.py both resolve
+    through this, so a spec that validates is exactly the spec the
+    weighted-fair pickup runs.  Unlisted tenants weigh 1.0 (the
+    RequestQueue applies that default at pickup, not here).
+    Dependency-light (no jax/numpy) so config stays importable anywhere.
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        return {}
+    out: Dict[str, float] = {}
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if ":" not in tok:
+            raise ValueError(
+                f"serve_fair_weights entry {tok!r} must be tenant:weight")
+        tenant, _, w = tok.rpartition(":")
+        tenant = tenant.strip()
+        if not tenant:
+            raise ValueError(
+                f"serve_fair_weights entry {tok!r} names no tenant (the "
+                f"default tenant's weight is always 1.0)")
+        try:
+            weight = float(w)
+        except ValueError:
+            raise ValueError(
+                f"serve_fair_weights weight {w!r} is not a number"
+            ) from None
+        if weight <= 0:
+            raise ValueError(
+                f"serve_fair_weights weight for {tenant!r} must be > 0, "
+                f"got {weight}")
+        out[tenant] = weight
+    return out
+
+
+def resolve_tenant_burst(hps: "HParams") -> int:
+    """Effective per-tenant token-bucket burst depth: the explicit
+    serve_tenant_burst, or ~one second of the configured rate (min 1)
+    when 0 — the ONE resolver, shared by serve/frontdoor.py and the
+    SLO gate so a committed isolation number runs the burst it names."""
+    if hps.serve_tenant_burst:
+        return hps.serve_tenant_burst
+    return max(1, int(hps.serve_tenant_rate + 0.999999))
 
 
 def beam_chunk_from_env() -> int:

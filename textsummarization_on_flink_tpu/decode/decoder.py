@@ -93,7 +93,8 @@ class DecodedResult:
                  reference: str, abstract_sents: List[str],
                  attn_dists: Optional[np.ndarray] = None,
                  p_gens: Optional[np.ndarray] = None,
-                 degraded: bool = False, tier: str = "beam"):
+                 degraded: bool = False, tier: str = "beam",
+                 params_fingerprint: str = ""):
         self.uuid = uuid
         self.article = article
         self.decoded_words = decoded_words
@@ -107,6 +108,12 @@ class DecodedResult:
         # the quality tier that produced this result (SERVING.md
         # "Quality tiers": beam|greedy|spec|draft)
         self.tier = tier
+        # fingerprint of the params snapshot that DECODED this result
+        # (ISSUE 14): the summary cache files entries under it, so a
+        # result produced just before a hot-swap lands under the
+        # snapshot that made it, never the one that replaced it ("" =
+        # producer without the surface: stubs, sim engines)
+        self.params_fingerprint = params_fingerprint
 
     @property
     def decoded_sents(self) -> List[str]:
@@ -149,6 +156,9 @@ class BeamSearchDecoder:
         # state (new params with the old checkpoint name, or vice versa)
         self._params_lock = threading.Lock()
         self._ckpt_path: Optional[str] = None
+        # (params object, its content fingerprint) — the
+        # params_fingerprint property's one-sha-per-swap memo
+        self._fp_cache: Optional[Tuple[Any, str]] = None
         # observability (`decode/` namespace, OBSERVABILITY.md):
         # per-request latency percentiles, finished beams, token volume
         # (tokens/sec = decode/tokens_total over decode/busy_seconds_total),
@@ -253,6 +263,24 @@ class BeamSearchDecoder:
         way for a dispatch to pick up weights while reloads may run."""
         with self._params_lock:
             return self._params, self._ckpt_path
+
+    @property
+    def params_fingerprint(self) -> str:
+        """Content fingerprint of the ACTIVE ``_params_snapshot``
+        (``checkpoint.checkpointer.content_fingerprint`` — the one
+        scheme the distill teacher sidecar also uses) — the serve
+        layer's cache key and /healthz surface (SERVING.md "Front
+        door").  Cached per swapped-in params OBJECT: the sha runs once
+        per checkpoint hot-swap, not per request (the cache tuple holds
+        the source tree, so object identity can never false-hit on a
+        recycled address)."""
+        params, _ = self._params_snapshot()
+        cached = self._fp_cache
+        if cached is not None and cached[0] is params:
+            return cached[1]
+        fp = ckpt_lib.content_fingerprint(params)
+        self._fp_cache = (params, fp)
+        return fp
 
     def _load_params(self) -> None:
         # load + decode OUTSIDE the lock (seconds of IO must not stall
@@ -508,7 +536,12 @@ class BeamSearchDecoder:
             abstract_sents=abstract_sents,
             attn_dists=attn_dists[: max(len(decoded_words), 1)],
             p_gens=p_gens[: max(len(decoded_words), 1)],
-            tier=tier)
+            tier=tier,
+            # the fingerprint memo is keyed on the snapshot object, so
+            # this is a dict read per result, not a sha — and a swap
+            # landing mid-batch at worst stamps the NEW snapshot on a
+            # result the old one decoded, which only costs a cache miss
+            params_fingerprint=self.params_fingerprint)
 
     def slot_engine(self, slots: int, chunk: int) -> "SlotDecodeEngine":
         """The continuous-batching engine over this decoder's params
@@ -691,6 +724,13 @@ class SlotDecodeEngine:
             # condition — engine and micro-batch search share ONE
             # mesh/registry by construction
             self._registry = decoder._mesh_plan.registry
+
+    @property
+    def params_fingerprint(self) -> str:
+        """The owning decoder's active-params fingerprint — the
+        continuous path's cache-key surface (one decoder, one
+        fingerprint, both serve modes; SERVING.md "Front door")."""
+        return self._dec.params_fingerprint
 
     def _params(self):
         """The decoder's params snapshot, placed against the registry's

@@ -15,6 +15,9 @@ injection points* compiled into the production code:
   ``serve.dispatch``  serve/server.py — per-(sub-)batch / per-tick dispatch
   ``serve.replica_kill``  serve/fleet.py — kills one fleet replica
                       mid-decode (residents/queued requeue on survivors)
+  ``serve.cache_fault``  serve/frontdoor.py — summary-cache layer
+                      failure (lookups degrade to miss-and-decode,
+                      inserts drop; never a wrong summary or a hang)
   ==================  =====================================================
 
 Arming — either source, same ``point:prob:seed[:max]`` syntax, comma-
@@ -58,7 +61,7 @@ ENV_VAR = "TS_FAULTS"
 KNOWN_POINTS = (
     "io.connect", "io.read", "io.write",
     "ckpt.load", "train.step_nan", "etl.worker",
-    "serve.dispatch", "serve.replica_kill",
+    "serve.dispatch", "serve.replica_kill", "serve.cache_fault",
 )
 
 

@@ -25,6 +25,12 @@ Layer map:
     beam/greedy/spec/draft, SERVING.md "Quality tiers") with
     per-request deadline re-tiering, between-batch checkpoint
     hot-swap, full obs instrumentation.
+  * ``frontdoor`` — the production front door (ISSUE 14, SERVING.md
+    "Front door"): ``article_key`` content hashing, in-flight
+    coalescing, the bounded (content_hash, tier, params_fingerprint)
+    ``SummaryCache``, and per-tenant token-bucket admission —
+    ``FrontDoor`` sits between submit and the queue in BOTH the
+    single-server and fleet paths (jax-free).
   * ``router``/``fleet`` — the elastic fleet (ISSUE 13, SERVING.md
     "Elastic fleet"): ``ReplicaHandle`` rotation state + least-loaded
     ``pick_replica`` (``router``), and the ``FleetRouter`` fronting N
@@ -44,6 +50,7 @@ from textsummarization_on_flink_tpu.serve.errors import (
     ServeClosedError,
     ServeError,
     ServeOverloadError,
+    TenantThrottledError,
 )
 from textsummarization_on_flink_tpu.serve.queue import (
     RequestQueue,
@@ -55,11 +62,18 @@ from textsummarization_on_flink_tpu.serve.batcher import (
     MicroBatcher,
     resolve_buckets,
 )
+from textsummarization_on_flink_tpu.serve.frontdoor import (
+    FrontDoor,
+    SummaryCache,
+    article_key,
+)
 
 __all__ = [
-    "ContinuousBatcher", "FleetRouter", "MicroBatcher", "ReplicaKilledError",
-    "RequestQueue", "ServeClosedError", "ServeError", "ServeFuture",
-    "ServeOverloadError", "ServeRequest", "ServingServer", "resolve_buckets",
+    "ContinuousBatcher", "FleetRouter", "FrontDoor", "MicroBatcher",
+    "ReplicaKilledError", "RequestQueue", "ServeClosedError", "ServeError",
+    "ServeFuture", "ServeOverloadError", "ServeRequest", "ServingServer",
+    "SummaryCache", "TenantThrottledError", "article_key",
+    "resolve_buckets",
 ]
 
 

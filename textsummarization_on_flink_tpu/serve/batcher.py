@@ -346,9 +346,13 @@ class ContinuousBatcher:
                     f"request {req.uuid!r} deadline expired while "
                     f"queued"))
                 continue
+            # tenant rides the admit event when named (ISSUE 14): the
+            # weighted-fair pickup's interleaving is reconstructable
+            # per uuid from the same stream bench's queue split reads
             obs.spans.request_event(
                 self._reg, "admit", req.trace, req.uuid,
-                queue_ms=round(queue_s * 1e3, 3))
+                queue_ms=round(queue_s * 1e3, 3),
+                **({"tenant": req.tenant} if req.tenant else {}))
             return req
 
     def _prefill_stage(self, poll: float) -> None:

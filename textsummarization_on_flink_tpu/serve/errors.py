@@ -12,6 +12,10 @@ them) keep working:
   * ``ServeClosedError`` — the server is stopping/stopped; submissions
     are refused and any request still queued at hard-stop is rejected
     with this.
+  * ``TenantThrottledError`` — the request's tenant is over its
+    token-bucket admission rate (the front door's per-tenant shed,
+    SERVING.md "Front door"); a subclass of ``ServeOverloadError`` so
+    overload handlers keep working.
   * ``ReplicaKilledError`` — the replica serving this request died
     mid-decode (chaos ``serve.replica_kill``, or a real crash surfaced
     through ``ServingServer.kill``).  The FleetRouter routes on exactly
@@ -39,6 +43,15 @@ class ServeOverloadError(ServeError):
 
 class ServeClosedError(ServeError):
     """The serving server is stopped (or stopping); no new requests."""
+
+
+class TenantThrottledError(ServeOverloadError):
+    """The request's TENANT is over its token-bucket admission rate
+    (serve_tenant_rate, SERVING.md "Front door"); the request was shed
+    before touching the queue or the admission breaker — one tenant's
+    burst spends its own bucket, never the shared queue.  Subclasses
+    ``ServeOverloadError`` so existing overload handlers (retry with
+    backoff, shed) keep working unchanged."""
 
 
 class ReplicaKilledError(ServeError):

@@ -74,9 +74,11 @@ from textsummarization_on_flink_tpu.serve.errors import (
     ServeClosedError,
     ServeOverloadError,
 )
+from textsummarization_on_flink_tpu.serve.frontdoor import FrontDoor
 from textsummarization_on_flink_tpu.serve.queue import ServeFuture
 from textsummarization_on_flink_tpu.serve.router import (
     ReplicaHandle,
+    fleet_fingerprint,
     pick_replica,
     refresh_rotation,
 )
@@ -93,17 +95,19 @@ class _Routed:
     settles only when it is the last attempt standing (a hedge twin or
     a requeued copy may still win)."""
 
-    __slots__ = ("uuid", "article", "reference", "tier", "future", "ctx",
+    __slots__ = ("uuid", "article", "reference", "tier", "tenant",
+                 "future", "ctx",
                  "submit_t", "hedged", "requeues", "tried", "_outstanding",
                  "_settled", "_last_error", "_lock")
 
     def __init__(self, uuid: str, article: str, reference: str, tier: str,
                  future: ServeFuture, ctx: Optional[obs.TraceContext],
-                 submit_t: float):
+                 submit_t: float, tenant: str = ""):
         self.uuid = uuid
         self.article = article
         self.reference = reference
         self.tier = tier
+        self.tenant = tenant
         self.future = future
         self.ctx = ctx
         self.submit_t = submit_t
@@ -235,6 +239,20 @@ class FleetRouter:
         self._max_requeues = max(1, len(items) - 1)
         self._faults = faults if faults is not None \
             else faultinject.plan_for(hps)
+        # the fleet-level front door (ISSUE 14; SERVING.md "Front
+        # door"): coalescing/caching dedup ACROSS replicas and tenant
+        # tokens are charged once, here — so each replica's own door is
+        # disarmed below.  Cache lookups key on the fleet's COMMON
+        # fingerprint; mid-rolling-swap (replicas disagreeing) the
+        # lookup side goes dark rather than serve one snapshot's
+        # summary under another's key (inserts still file under the
+        # decode-time fingerprint riding each result).
+        self._door = FrontDoor(hps, registry=self._reg,
+                               fingerprint=self._fleet_fingerprint,
+                               clock=clock, faults=self._faults)
+        for h in self._handle_list:
+            if hasattr(h.server, "disable_front_door"):
+                h.server.disable_front_door()
         self._lock = threading.Lock()
         self._inflight: List[_Routed] = []
         self._n_submitted = 0
@@ -321,14 +339,30 @@ class FleetRouter:
     def __exit__(self, exc_type, exc, tb) -> None:
         self.stop()
 
+    def _fleet_fingerprint(self) -> Optional[str]:
+        """The fleet's cache-lookup fingerprint — the routing-policy
+        helper ``router.fleet_fingerprint`` over this fleet's handles
+        (None while live replicas disagree mid-swap: lookups go dark)."""
+        return fleet_fingerprint(self._handle_list)
+
     # -- request API --
     def submit(self, article: str, uuid: str = "", reference: str = "",
                block: bool = False, timeout: Optional[float] = None,
-               tier: str = "") -> ServeFuture:
+               tier: str = "", tenant: str = "") -> ServeFuture:
         """Route one request to the least-loaded in-rotation replica;
         returns the ROUTER-level future (resolves exactly once, from
         whichever replica attempt wins).  Raises the typed
-        ``ServeOverloadError`` when no replica will take it.
+        ``ServeOverloadError`` when no replica will take it (or
+        ``TenantThrottledError`` when `tenant` is over its admission
+        rate — charged once, here, never again per attempt).
+
+        Front door (ISSUE 14): with caching/coalescing armed this is
+        the fleet's ONE dedup point — a duplicate of an in-flight
+        (content_hash, tier) attaches to the routed leader's
+        exactly-once future, so hedging and kill-requeue happen UNDER
+        the leader and every attached future resolves from whichever
+        replica attempt finally wins; a cache hit resolves here without
+        touching any replica.
 
         One TraceContext is minted here and threaded through every
         replica attempt, so the uuid's cross-replica lifecycle
@@ -337,34 +371,68 @@ class FleetRouter:
         with self._lock:
             if self._closed:
                 raise ServeClosedError("fleet router is stopped")
+        # normalize the tier BEFORE the door, exactly like
+        # ServingServer.submit: "" and the explicit default must key
+        # the same flight and the same cache entry, or identical
+        # requests would split into separate decodes purely on how the
+        # caller spelled the default
+        tier = tier or getattr(self._hps, "serve_default_tier", "beam")
+        flight = None
+        if self._door.armed:
+            self._door.admit_tenant(tenant, uuid)
+            kind, val = self._door.open(article, tier, uuid, reference)
+            if kind in ("hit", "follower"):
+                # hits and followers ARE fleet admissions (the counter's
+                # documented meaning, and the hedge waste cap's
+                # denominator — undercounting would suppress hedges far
+                # below the committed ratio of real admitted traffic)
+                with self._lock:
+                    self._n_submitted += 1
+                self._c_submitted.inc()
+                return val
+            if kind == "leader":
+                flight = val
         ctx = obs.TraceContext.new() if self._reg.enabled else None
         future = ServeFuture(uuid, registry=self._reg)
         future.trace = ctx
         future.scope = "fleet"  # the TERMINAL resolve in the trace
         routed = _Routed(uuid, article, reference, tier, future, ctx,
-                         submit_t=self._clock())
-        last_error: Optional[BaseException] = None
-        while True:
-            with self._lock:
-                handle = pick_replica(self._handle_list,
-                                      exclude=routed.tried)
-            if handle is None:
-                if last_error is not None:
+                         submit_t=self._clock(), tenant=tenant)
+        try:
+            last_error: Optional[BaseException] = None
+            while True:
+                with self._lock:
+                    handle = pick_replica(self._handle_list,
+                                          exclude=routed.tried)
+                if handle is None:
+                    if last_error is None:
+                        last_error = ServeOverloadError(
+                            f"no serving replica in rotation for request "
+                            f"{uuid!r} ({len(self._handle_list)} "
+                            f"configured)")
                     # surface the replicas' own typed verdict: a caller
                     # must be able to tell retryable overload from a
                     # terminal ServeClosedError (stopped replicas)
                     raise last_error
-                raise ServeOverloadError(
-                    f"no serving replica in rotation for request "
-                    f"{uuid!r} ({len(self._handle_list)} configured)")
-            err = self._attempt(routed, handle, block=block,
-                                timeout=timeout)
-            if err is None:
-                break
-            last_error = err
+                err = self._attempt(routed, handle, block=block,
+                                    timeout=timeout)
+                if err is None:
+                    break
+                last_error = err
+        except BaseException as e:
+            # the leader never got routed — typed overload, or a
+            # replica's own synchronous verdict (e.g. a tier the
+            # replica cannot serve, which _attempt does NOT swallow):
+            # the flight must die with it or every later duplicate
+            # would attach to a leader that never existed and hang
+            if flight is not None:
+                self._door.abort(flight, e)
+            raise
         with self._lock:
             self._inflight.append(routed)
             self._n_submitted += 1
+        if flight is not None:
+            self._door.commit(flight, future)
         self._c_submitted.inc()
         return future
 
@@ -382,10 +450,14 @@ class FleetRouter:
             self._reg, "route", routed.ctx, routed.uuid,
             replica=handle.rid, hedge=hedge)
         try:
+            # tenant rides along only when named: the default ""
+            # tenant keeps pre-tenant replica surfaces (external
+            # routers' stubs) callable unchanged
+            kw = {"tenant": routed.tenant} if routed.tenant else {}
             fut = handle.server.submit(
                 routed.article, uuid=routed.uuid,
                 reference=routed.reference, block=block, timeout=timeout,
-                tier=routed.tier, trace=routed.ctx)
+                tier=routed.tier, trace=routed.ctx, **kw)
         except (ServeOverloadError, ServeClosedError) as e:
             handle.breaker.record_failure()
             return e

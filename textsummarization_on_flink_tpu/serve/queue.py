@@ -17,16 +17,26 @@ applied to the ingress side), then a half-open probe admission decides
 recovery.  Blocking submits (the pipeline-driving path) exert
 backpressure instead: they wait for space and bypass the breaker.
 
+Multi-tenant fairness (ISSUE 14; SERVING.md "Front door"): each
+``ServeRequest`` carries a ``tenant`` ("" = the default tenant), the
+queue keeps one FIFO per tenant under the shared depth bound, and the
+CONSUMER side (``get``/``get_nowait``) picks across the non-empty
+tenants by smooth weighted round-robin (``serve_fair_weights``) — so
+one tenant's deep backlog cannot starve another's pickup, while a
+single-tenant queue degenerates to exactly the historical global FIFO
+(same tenant => strict arrival order).  The admission-rate side (the
+per-tenant token bucket) lives in serve/frontdoor.py.
+
 Import-light: no jax; numpy only transitively via data.batching.
 """
 
 from __future__ import annotations
 
 import logging
-import queue as queue_lib
 import threading
 import time
-from typing import Any, Callable, List, Optional
+from collections import OrderedDict, deque
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.resilience.policy import (
@@ -39,6 +49,12 @@ from textsummarization_on_flink_tpu.serve.errors import (
 )
 
 log = logging.getLogger(__name__)
+
+#: bound on the fair-pickup credit map: caller-supplied tenant names
+#: must not grow it without end — once past the bound, credits of
+#: tenants with NO queued work are pruned (their fairness debt is at
+#: most one round's weight, so the reset is noise)
+MAX_TENANT_CREDITS = 4096
 
 
 class ServeFuture:
@@ -147,16 +163,21 @@ class ServeRequest:
     """One admitted (or about-to-be-admitted) summarization request."""
 
     __slots__ = ("uuid", "article", "reference", "example", "future",
-                 "deadline", "enqueue_t", "trace", "tier")
+                 "deadline", "enqueue_t", "trace", "tier", "tenant")
 
     def __init__(self, uuid: str, article: str, reference: str,
                  example: Any, deadline: Optional[Deadline] = None,
                  registry: Optional[obs.Registry] = None,
-                 tier: str = "", trace: Optional[obs.TraceContext] = None):
+                 tier: str = "", trace: Optional[obs.TraceContext] = None,
+                 tenant: str = ""):
         self.uuid = uuid
         self.article = article
         self.reference = reference
         self.example = example  # data.batching.SummaryExample
+        # the tenant whose fairness bucket this request rides ("" = the
+        # default tenant — a job that never names tenants keeps ONE
+        # bucket and therefore the historical global-FIFO pickup)
+        self.tenant = tenant
         # requested quality tier (SERVING.md "Quality tiers"): one of
         # config.SERVE_TIERS, or "" = the server's default.  The
         # EFFECTIVE tier may be lower — per-request deadline-pressure
@@ -197,6 +218,14 @@ class RequestQueue:
     waits up to `timeout` for space (backpressure; no breaker
     involvement) and raises ``ServeOverloadError`` only on timeout.
 
+    Weighted-fair pickup (ISSUE 14): internally one FIFO per tenant
+    under the shared ``max_depth`` bound; ``get``/``get_nowait`` pick
+    the next tenant by smooth weighted round-robin over the NON-EMPTY
+    tenants (``fair_weights``, unlisted tenants weigh 1.0) and pop that
+    tenant's head — per-tenant order stays FIFO, cross-tenant pickup
+    interleaves by weight, and the single-tenant case is byte-for-byte
+    the historical global FIFO.
+
     Metrics (serve/ namespace, SERVING.md): ``serve/queue_depth`` gauge,
     ``serve/submitted_total`` / ``serve/shed_total`` counters, and the
     admission breaker's ``resilience/serve.admission/*`` family.
@@ -204,12 +233,27 @@ class RequestQueue:
 
     def __init__(self, max_depth: int,
                  breaker: Optional[CircuitBreaker] = None,
-                 registry: Optional[obs.Registry] = None):
+                 registry: Optional[obs.Registry] = None,
+                 fair_weights: Optional[Dict[str, float]] = None):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.max_depth = max_depth
-        self._q: "queue_lib.Queue[ServeRequest]" = queue_lib.Queue(
-            maxsize=max_depth)
+        # per-tenant FIFOs + TWO conditions over one lock (the stdlib
+        # Queue discipline): producers blocked on space wait on
+        # not_full, consumers on not_empty, and each side wakes exactly
+        # ONE waiter per transition — notify_all here would cost
+        # O(waiters) context switches per request under the
+        # high-concurrency load the serve bench measures
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._buckets: "OrderedDict[str, Deque[ServeRequest]]" = \
+            OrderedDict()
+        self._size = 0
+        self._weights: Dict[str, float] = dict(fair_weights or {})
+        #: smooth-WRR credits, persistent across pickups so a tenant's
+        #: fairness debt survives its bucket draining and refilling
+        self._credits: Dict[str, float] = {}
         reg = registry if registry is not None else obs.registry()
         self._reg = reg
         # under sustained overload there is no point probing the queue
@@ -255,17 +299,12 @@ class RequestQueue:
         # lifecycle root event BEFORE the queue put: the instant the
         # request becomes visible to the dispatch thread it may emit
         # admit/slot/resolve, and those must never precede enqueue in
-        # the stream (a Full put turns the trace into enqueue -> shed —
-        # an honest timeline for a request that reached the queue and
-        # bounced)
+        # the stream (a full-queue bounce turns the trace into
+        # enqueue -> shed — an honest timeline for a request that
+        # reached the queue and bounced)
         obs.spans.request_event(self._reg, "enqueue", req.trace, req.uuid,
-                                depth=self._q.qsize())
-        try:
-            if block:
-                self._q.put(req, timeout=timeout)
-            else:
-                self._q.put_nowait(req)
-        except queue_lib.Full:
+                                depth=self._size)
+        if not self._put(req, block, timeout):
             if not block:
                 self._breaker.record_failure()
             self._c_shed.inc()
@@ -277,30 +316,108 @@ class RequestQueue:
         if not block:
             self._breaker.record_success()
         self._c_submitted.inc()
-        self._g_depth.set(self._q.qsize())
+        self._g_depth.set(self._size)
+
+    def _put(self, req: ServeRequest, block: bool,
+             timeout: Optional[float]) -> bool:
+        """Append `req` to its tenant's FIFO; False when full (after
+        waiting up to `timeout` in blocking mode)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self._size >= self.max_depth:
+                if not block:
+                    return False
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                # loop on the PREDICATE, never on wait()'s verdict: a
+                # wake that races the timeout consumes the notify, and
+                # shedding here would bounce a request against a queue
+                # that just freed a slot (the stdlib Queue.put
+                # discipline — the next iteration's remaining<=0 check
+                # is what enforces the deadline)
+                self._not_full.wait(remaining)
+            self._buckets.setdefault(req.tenant or "",
+                                     deque()).append(req)
+            self._size += 1
+            self._not_empty.notify()
+        return True
+
+    def _pick_tenant(self) -> str:
+        """Smooth weighted round-robin over the NON-EMPTY tenant FIFOs
+        (caller holds the condition lock, size > 0): every candidate
+        earns its weight in credit, the richest one pays the round's
+        total back and is picked — over time each tenant's pickup share
+        converges to weight/sum(weights) regardless of backlog depth.
+        Deterministic: insertion order breaks ties (strict >), so the
+        virtual-time SLO gate replays exactly."""
+        total = 0.0
+        best: Optional[str] = None
+        for tenant, bucket in self._buckets.items():
+            if not bucket:
+                continue
+            w = self._weights.get(tenant, 1.0)
+            total += w
+            credit = self._credits.get(tenant, 0.0) + w
+            self._credits[tenant] = credit
+            if best is None or credit > self._credits[best]:
+                best = tenant
+        assert best is not None  # caller guarantees size > 0
+        self._credits[best] -= total
+        return best
+
+    def _pop(self) -> Optional[ServeRequest]:
+        """Pop the next request by fair pickup (caller holds the lock);
+        None when empty."""
+        if self._size == 0:
+            return None
+        tenant = self._pick_tenant()
+        bucket = self._buckets[tenant]
+        req = bucket.popleft()
+        if not bucket:
+            # drop the empty FIFO so the pickup scan stays proportional
+            # to the ACTIVE tenant count (credits persist separately —
+            # but bounded: past MAX_TENANT_CREDITS, idle tenants'
+            # residual debt is pruned rather than leaked)
+            del self._buckets[tenant]
+            if len(self._credits) > MAX_TENANT_CREDITS:
+                for t in [t for t in self._credits
+                          if t not in self._buckets]:
+                    if len(self._credits) <= MAX_TENANT_CREDITS:
+                        break
+                    del self._credits[t]
+        self._size -= 1
+        self._not_full.notify()
+        return req
 
     def get(self, timeout: float = 0.05) -> Optional[ServeRequest]:
-        """Next request, or None after `timeout` seconds idle."""
-        try:
-            req = self._q.get(timeout=timeout)
-        except queue_lib.Empty:
-            return None
-        self._g_depth.set(self._q.qsize())
+        """Next request by weighted-fair pickup, or None after
+        `timeout` seconds idle."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._size == 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._not_empty.wait(remaining):
+                    if self._size == 0:
+                        return None
+            req = self._pop()
+        self._g_depth.set(self._size)
         return req
 
     def get_nowait(self) -> Optional[ServeRequest]:
-        try:
-            req = self._q.get_nowait()
-        except queue_lib.Empty:
+        with self._lock:
+            req = self._pop()
+        if req is None:
             return None
-        self._g_depth.set(self._q.qsize())
+        self._g_depth.set(self._size)
         return req
 
     def qsize(self) -> int:
-        return self._q.qsize()
+        return self._size
 
     def empty(self) -> bool:
-        return self._q.empty()
+        return self._size == 0
 
     def drain_reject(self, error: BaseException) -> int:
         """Reject every still-queued request with `error` (hard stop);

@@ -113,6 +113,25 @@ def pick_replica(handles: Sequence[ReplicaHandle],
     return best
 
 
+def fleet_fingerprint(handles: Sequence[ReplicaHandle],
+                      ) -> Optional[str]:
+    """The fleet's COMMON active-params fingerprint (the FleetRouter's
+    summary-cache lookup key, SERVING.md "Front door"): the one
+    fingerprint every live replica reports, or None while they
+    disagree — mid-rolling-swap, which snapshot serves the next decode
+    depends on routing, so a mixed fleet must not answer cache lookups
+    (inserts still file under the decode-time fingerprint each result
+    carries, so no entry is ever mis-keyed)."""
+    fps = set()
+    for h in handles:
+        if h.killed:
+            continue
+        fps.add(getattr(h.server, "params_fingerprint", "") or "")
+        if len(fps) > 1:
+            return None
+    return next(iter(fps)) if fps else ""
+
+
 def refresh_rotation(handles: Sequence[ReplicaHandle],
                      ) -> List[Tuple[str, str]]:
     """One health sweep over the fleet (the router tick's rotation
@@ -142,4 +161,5 @@ def refresh_rotation(handles: Sequence[ReplicaHandle],
     return events
 
 
-__all__ = ["ReplicaHandle", "pick_replica", "refresh_rotation"]
+__all__ = ["ReplicaHandle", "fleet_fingerprint", "pick_replica",
+           "refresh_rotation"]

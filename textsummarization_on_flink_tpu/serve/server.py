@@ -88,6 +88,7 @@ from textsummarization_on_flink_tpu.obs import http as obs_http
 from textsummarization_on_flink_tpu.config import (
     SERVE_TIERS,
     HParams,
+    parse_fair_weights,
     resolve_refill_chunk,
     resolve_serve_slots,
 )
@@ -113,6 +114,7 @@ from textsummarization_on_flink_tpu.serve.errors import (
     ServeClosedError,
     ServeOverloadError,
 )
+from textsummarization_on_flink_tpu.serve.frontdoor import FrontDoor
 from textsummarization_on_flink_tpu.serve.queue import (
     RequestQueue,
     ServeFuture,
@@ -142,7 +144,8 @@ class ServingServer:
                  decoder: Optional[Any] = None,
                  decode_root: Optional[str] = None,
                  engine: Optional[Any] = None,
-                 registry: Optional[obs.Registry] = None):
+                 registry: Optional[obs.Registry] = None,
+                 clock: Any = time.monotonic):
         self._hps = hps
         self._vocab = vocab
         self._reg = registry if registry is not None else obs.registry_for(hps)
@@ -157,8 +160,20 @@ class ServingServer:
                 hps.replace(single_pass=False), vocab, batcher=None,
                 params=params, train_dir=train_dir, decode_root=decode_root)
         self._decoder = decoder
-        self._queue = RequestQueue(hps.serve_max_queue, registry=self._reg)
+        self._queue = RequestQueue(
+            hps.serve_max_queue, registry=self._reg,
+            fair_weights=parse_fair_weights(
+                getattr(hps, "serve_fair_weights", "")))
         self._faults = faultinject.plan_for(hps)
+        # the serving front door (ISSUE 14; SERVING.md "Front door"):
+        # per-tenant token-bucket admission, the (content_hash, tier,
+        # params_fingerprint) summary cache, and in-flight coalescing —
+        # all between submit and the queue.  `clock` is injectable so
+        # the virtual-time SLO gate refills tenant buckets on virtual
+        # seconds.  Lookups key on THIS server's live fingerprint.
+        self._door = FrontDoor(hps, registry=self._reg,
+                               fingerprint=lambda: self.params_fingerprint,
+                               clock=clock, faults=self._faults)
         self._mode = getattr(hps, "serve_mode", "microbatch")
         self._batcher: Optional[MicroBatcher] = None
         self._cont: Optional[ContinuousBatcher] = None
@@ -205,8 +220,18 @@ class ServingServer:
         # the router's routing inputs ride /healthz (ISSUE 13): the
         # effective serve_mode joins the queue-depth/slots-free gauges
         # in the JSON body, so an external router scrapes the same
-        # facts the in-process FleetRouter reads off stats()
-        obs_http.set_health_info(self._reg, serve_mode=self._mode)
+        # facts the in-process FleetRouter reads off stats().  The
+        # ACTIVE params fingerprint rides along (ISSUE 14): an external
+        # cache tier keys on exactly what the in-process summary cache
+        # keys on, and a hot-swap is observable as the value changing.
+        # the eager sha (one D2H + full-tree hash) is only worth paying
+        # when something will read it: an enabled registry's /healthz,
+        # or an armed door's cache lookups (which memoize through the
+        # decoder anyway).  A dark job skips it entirely.
+        self._published_fp = (self.params_fingerprint
+                              if self._reg.enabled else "")
+        obs_http.set_health_info(self._reg, serve_mode=self._mode,
+                                 params_fingerprint=self._published_fp)
         self._h_queue_time = self._reg.histogram(
             "serve/time_in_queue_seconds")
         self._h_e2e = self._reg.histogram("serve/e2e_latency_seconds")
@@ -333,12 +358,47 @@ class ServingServer:
             # -inf forces the cadence check; the decoder's params lock
             # still makes the (params, ckpt, draft) swap atomic
             self._decoder.maybe_reload_checkpoint(float("-inf"))
+            self._publish_fingerprint()
             return True
         except Exception:
             self._reg.counter("serve/ckpt_reload_errors_total").inc()
             log.exception("router-orchestrated hot-swap failed; serving "
                           "on the current snapshot")
             return False
+
+    def disable_front_door(self) -> None:
+        """Disarm THIS server's front door (FleetRouter construction):
+        behind a router, coalescing/caching must dedup ACROSS replicas
+        and tenant tokens must be charged exactly once — so the router
+        runs the one front door and replicas serve what they are
+        routed.  (A hedged twin or a requeue would otherwise coalesce
+        against its own primary, or double-spend a tenant's bucket.)
+        Also releases this replica's now-dead cache."""
+        self._door.disarm()
+
+    @property
+    def params_fingerprint(self) -> str:
+        """The ACTIVE params fingerprint (the decoder's cached sha over
+        its current ``_params_snapshot``; "" for decoders without the
+        surface — stubs, the SLO gate's sims — which therefore cache
+        consistently under the empty fingerprint).  The summary cache's
+        lookup key (SERVING.md "Front door")."""
+        fp = getattr(self._decoder, "params_fingerprint", "")
+        return fp if isinstance(fp, str) else ""
+
+    def _publish_fingerprint(self) -> None:
+        """Refresh the /healthz fingerprint after a (possible) swap.
+        Called once per dispatch loop / tick but gated on the CHANGE:
+        the decoder's sha is memoized per params object, and the
+        health-info dict update only runs when the value moved (at
+        most once per actual reload, not per tick).  Dark registries
+        skip even the memoized read — nothing would serve the value."""
+        if not self._reg.enabled:
+            return
+        fp = self.params_fingerprint
+        if fp != self._published_fp:
+            self._published_fp = fp
+            obs_http.set_health_info(self._reg, params_fingerprint=fp)
 
     def idle(self) -> bool:
         """True when the server holds NO admitted work: queue empty, no
@@ -406,7 +466,8 @@ class ServingServer:
     def submit(self, article: str, uuid: str = "", reference: str = "",
                block: bool = False, timeout: Optional[float] = None,
                tier: str = "",
-               trace: Optional[obs.TraceContext] = None) -> ServeFuture:
+               trace: Optional[obs.TraceContext] = None,
+               tenant: str = "") -> ServeFuture:
         """Admit one request; returns its future.
 
         Non-blocking (default): full queue / open admission breaker
@@ -421,6 +482,20 @@ class ServingServer:
         against a decoder with no draft model, or a non-beam tier on a
         continuous-mode server (the persistent slot state is fixed-beam
         by construction).
+
+        ``tenant`` names the request's fairness/admission tenant
+        (SERVING.md "Front door"; "" = the default tenant, today's
+        behavior).  With ``serve_tenant_rate`` armed, an over-rate
+        tenant's submit sheds HERE with the typed
+        ``TenantThrottledError``; with fair weights configured, pickup
+        interleaves tenants by weight.
+
+        Front door (ISSUE 14): with the summary cache armed a hit
+        resolves the returned future SYNCHRONOUSLY (byte-identical to
+        a fresh decode of the same (article, tier, fingerprint), queue
+        untouched); with coalescing armed a duplicate of an in-flight
+        (content_hash, tier) attaches to that one computation and
+        resolves from its result.
 
         The per-request Deadline starts NOW (enqueue), so queue wait
         spends the ``decode_deadline_secs`` budget and an aged request
@@ -450,15 +525,46 @@ class ServingServer:
                 f"tier={tier!r} needs a draft model: set hps.spec_draft "
                 f"('map'/'fresh') or construct the decoder with "
                 f"draft_params=")
-        example = SummaryExample.build(
-            article, [], self._vocab, self._hps,
-            uuid=uuid, reference=reference)
-        req = ServeRequest(
-            uuid, article, reference, example,
-            deadline=Deadline.after(
-                getattr(self._hps, "decode_deadline_secs", 0.0)),
-            registry=self._reg, tier=tier, trace=trace)
-        self._queue.submit(req, block=block, timeout=timeout)
+        flight = None
+        if self._door.armed:
+            # a stopped/killed server refuses new submits — checked
+            # BEFORE the door, or a cached article would keep
+            # "succeeding" against a dead server while uncached ones
+            # raise typed (the shutdown contract must not depend on
+            # what happens to be cached)
+            if self._queue.closed:
+                raise ServeClosedError("serving queue is closed")
+            # tenant bucket FIRST (a throttled tenant must not probe
+            # the cache), then cache/coalescing — both before the
+            # queue, so a hit or a follower never spends queue depth
+            self._door.admit_tenant(tenant, uuid)
+            kind, val = self._door.open(article, tier, uuid, reference,
+                                        trace=trace)
+            if kind in ("hit", "follower"):
+                return val
+            if kind == "leader":
+                flight = val
+        try:
+            example = SummaryExample.build(
+                article, [], self._vocab, self._hps,
+                uuid=uuid, reference=reference)
+            req = ServeRequest(
+                uuid, article, reference, example,
+                deadline=Deadline.after(
+                    getattr(self._hps, "decode_deadline_secs", 0.0)),
+                registry=self._reg, tier=tier, trace=trace, tenant=tenant)
+            self._queue.submit(req, block=block, timeout=timeout)
+        except BaseException as e:
+            if flight is not None:
+                # the leader died before admission completed —
+                # tokenization error, queue full, closed: any follower
+                # that attached in the window fails with the same typed
+                # cause (it asked for exactly this computation), and
+                # the flight is retired so later duplicates lead fresh
+                self._door.abort(flight, e)
+            raise
+        if flight is not None:
+            self._door.commit(flight, req.future)
         return req.future
 
     def pending(self) -> int:
@@ -571,6 +677,7 @@ class ServingServer:
                 # lock makes the (params, ckpt_name) swap atomic even
                 # against out-of-band decode_batch callers
                 t_last = self._decoder.maybe_reload_checkpoint(t_last)
+                self._publish_fingerprint()
             except Exception:
                 # a failed reload must not kill the dispatch thread —
                 # that would hang every queued and future request; the
@@ -603,7 +710,9 @@ class ServingServer:
             # same hot-swap cadence as the micro-batch loop (the
             # decoder self-gates at 60s); a resident article picks
             # up new params at its next chunk boundary (SERVING.md)
-            return self._decoder.maybe_reload_checkpoint(t_last)
+            t_next = self._decoder.maybe_reload_checkpoint(t_last)
+            self._publish_fingerprint()
+            return t_next
         except Exception:
             self._reg.counter("serve/ckpt_reload_errors_total").inc()
             log.exception("between-chunk checkpoint reload failed; "
@@ -693,16 +802,17 @@ class ServingServer:
                 r.future._reject(DeadlineExceededError(
                     f"request {r.uuid!r} deadline expired while queued"))
                 continue
+            tattr = {"tenant": r.tenant} if r.tenant else {}
             if legacy:
                 obs.spans.request_event(
                     self._reg, "admit", r.trace, r.uuid,
-                    queue_ms=round(queue_s * 1e3, 3))
+                    queue_ms=round(queue_s * 1e3, 3), **tattr)
                 by_tier.setdefault(None, []).append((r, False))
                 continue
             tier, degraded = self._effective_tier(r)
             obs.spans.request_event(
                 self._reg, "admit", r.trace, r.uuid,
-                queue_ms=round(queue_s * 1e3, 3), tier=tier)
+                queue_ms=round(queue_s * 1e3, 3), tier=tier, **tattr)
             by_tier.setdefault(tier, []).append((r, degraded))
         for tier, members in by_tier.items():
             self._dispatch_tier(tier, members)

@@ -39,7 +39,6 @@ re-deriving on checkpoint hot-swap under the decoder's params lock.
 
 from __future__ import annotations
 
-import hashlib
 import json
 import logging
 import os
@@ -63,15 +62,13 @@ def teacher_fingerprint(full_params: Any) -> str:
     """Content fingerprint of the frozen teacher: sha256 over every
     leaf's bytes in deterministic (flattened-name) order.  Cheap at
     any committed scale (one pass over ~100 MB) and exact — two
-    teachers collide only if they are byte-identical."""
+    teachers collide only if they are byte-identical.  Delegates to
+    the ONE scheme (``checkpoint.checkpointer.content_fingerprint``,
+    shared with the serve layer's summary-cache key since ISSUE 14) so
+    sidecar and cache fingerprints can never drift."""
     from textsummarization_on_flink_tpu.checkpoint import checkpointer as ck
 
-    flat = ck._flatten(jax.device_get(full_params))
-    h = hashlib.sha256()
-    for name in sorted(flat):
-        h.update(name.encode("utf-8"))
-        h.update(np.ascontiguousarray(flat[name]).tobytes())
-    return h.hexdigest()[:16]
+    return ck.content_fingerprint(full_params)
 
 
 def teacher_arrays(full_params: Any, hps: HParams,

@@ -103,6 +103,16 @@ DEFAULT: Dict[str, Any] = {
                 r"^FleetRouter\.(tick|_hedge_scan|_swap_step"
                 r"|_maybe_chaos_kill)$",
                 r"^ServingServer\.(_continuous_round|tick_once)$",
+                # the serving front door (ISSUE 14): open/admit run on
+                # EVERY submit, the leader-done callback on the
+                # dispatch thread at resolve time, and the queue's
+                # fair-pickup loop once per dequeue — a host sync in
+                # any of them serializes admission (or the dispatch
+                # loop) for every caller at once
+                r"^FrontDoor\.(open|admit_tenant|_leader_done|_close)$",
+                r"^SummaryCache\.(get|put)$",
+                r"^RequestQueue\.(_put|_pop|_pick_tenant|get"
+                r"|get_nowait)$",
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
