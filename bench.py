@@ -1721,6 +1721,21 @@ def bench_serve() -> None:
             "decodes_per_submit": round(
                 (reg.counter("serve/completed_total").value - completed0)
                 / reqs, 4),
+            # telemetry-plane evidence (ISSUE 15): per-tier fast-window
+            # burn rates off the installed SLO engine (SLO_POLICY.json
+            # tier_latency objective; {} when no engine installed) and
+            # the number of latency buckets carrying a trace exemplar —
+            # a row with exemplars is a row whose p99 names a concrete
+            # request.  Row fields only, fingerprint-neutral.
+            "slo_burn_fast_by_tier": {
+                row["key"]: row["burn_fast"]
+                for row in (reg.slo.evaluate() if reg.slo is not None
+                            else ())
+                if row["objective"] == "tier_latency"},
+            "exemplar_count": sum(
+                len(m.exemplars())
+                for m in (reg.get("serve/e2e_latency_seconds"),)
+                if m is not None),
             "model_family": hps.model_family,
             "spec_k": int(hps.spec_k),
             "timing": "wall-clock per request, enqueue -> resolved future "

@@ -26,12 +26,15 @@ Directory arguments prefer profiler captures when both kinds are
 present (the established behavior); point at the events.jsonl file
 directly — or a directory holding only events.jsonl — for span tables.
 
-``--request <uuid>`` switches to the request-timeline view (ISSUE 9):
-the ``{"kind": "request"}`` lifecycle events the serve path emits
-(enqueue -> admit -> slot -> finish -> resolve, OBSERVABILITY.md
+``--request <uuid-or-trace_id>`` switches to the request-timeline view
+(ISSUE 9): the ``{"kind": "request"}`` lifecycle events the serve path
+emits (enqueue -> admit -> slot -> finish -> resolve, OBSERVABILITY.md
 "Request-scoped tracing") are reconstructed for one uuid, printed with
 per-phase durations (queue wait vs resident/decode vs resolve fan-out),
-plus any spans stamped with the request's trace_id.
+plus any spans stamped with the request's trace_id.  A TRACE id works
+too (ISSUE 15): paste a histogram bucket's exemplar straight off
+``/metrics`` or ``/exemplars`` and the fat-p99 request's full
+cross-replica timeline comes back.
 """
 
 from __future__ import annotations
@@ -170,8 +173,10 @@ def _iter_jsonl(path: str):
 
 def request_timeline(paths, uuid: str) -> dict:
     """One request's reconstructed timeline from unified events.jsonl
-    file(s): its lifecycle events (by uuid), the spans sharing its
-    trace_id, and the per-phase durations.
+    file(s): its lifecycle events (by uuid — or by trace_id, so a
+    histogram EXEMPLAR off /metrics or /exemplars pastes straight in,
+    ISSUE 15), the spans sharing its trace_id, and the per-phase
+    durations.
 
     Returns {"uuid", "trace_id", "events": [...], "spans": [...],
     "phases": {...}} — events/spans sorted by ts_us.  Phases (ms):
@@ -179,15 +184,22 @@ def request_timeline(paths, uuid: str) -> dict:
     ->resolve when no finish event exists, e.g. a queue eviction),
     ``resolve`` = finish->resolve, ``total`` = enqueue->resolve.
     """
-    # pass 1: the uuid's request events (tiny result set).  Buffering
-    # the file's spans instead would hold memory proportional to the
-    # whole capture just to answer one uuid.
+    # pass 1: the uuid's (or exemplar trace_id's) request events (tiny
+    # result set).  Buffering the file's spans instead would hold
+    # memory proportional to the whole capture just to answer one uuid.
     events: list = []
     for path in paths:
         events.extend(r for r in _iter_jsonl(path)
                       if r.get("kind") == "request"
-                      and r.get("uuid") == uuid)
+                      and (r.get("uuid") == uuid
+                           or r.get("trace_id") == uuid))
     events.sort(key=lambda r: r.get("ts_us", 0))
+    # the argument may have been a trace_id: resolve the uuid the
+    # matched lifecycle events actually carry (first one wins — a
+    # trace_id maps to one routed request by construction)
+    uuids = [r["uuid"] for r in events if r.get("uuid")]
+    if uuids and uuid not in uuids:
+        uuid = uuids[0]
     trace_ids = {r["trace_id"] for r in events if r.get("trace_id")}
     trace_id = sorted(trace_ids)[0] if trace_ids else None
     # pass 2 (only when the uuid matched a trace): spans sharing its
@@ -291,10 +303,12 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--host-frames", action="store_true",
                     help="keep $file:line python-frame events")
-    ap.add_argument("--request", metavar="UUID", default=None,
+    ap.add_argument("--request", metavar="UUID_OR_TRACE_ID", default=None,
                     help="reconstruct ONE request's lifecycle timeline "
                          "(enqueue->admit->slot->finish->resolve) from "
-                         "unified events.jsonl instead of the op table")
+                         "unified events.jsonl instead of the op table; "
+                         "accepts a uuid or a trace_id (e.g. a histogram "
+                         "exemplar off /metrics or /exemplars)")
     args = ap.parse_args(argv)
 
     if args.request is not None:

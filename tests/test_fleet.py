@@ -64,7 +64,7 @@ class FakeReplicaServer:
                                  if not f.done()])
 
     def submit(self, article, uuid="", reference="", block=False,
-               timeout=None, tier="", trace=None):
+               timeout=None, tier="", trace=None, tenant=""):
         if self.killed:
             raise ServeClosedError("killed")
         fut = ServeFuture(uuid, registry=self.registry)
@@ -679,3 +679,135 @@ class TestCrossReplicaTrace:
 class _NullD:
     def maybe_reload_checkpoint(self, last):
         return last
+
+
+class TestFleetTelemetryPlane:
+    """ISSUE 15: replica identity threading and the /fleet/* source
+    map the router wires at construction."""
+
+    def test_replica_ids_threaded_to_replica_registries(self):
+        router, servers, _ = make_fleet(n=3)
+        for i, s in enumerate(servers):
+            assert s.registry.replica_id == f"r{i}"
+        # the router's own registry is the fleet view, not a replica
+        assert router.registry.replica_id == ""
+
+    def test_fleet_sources_wired_on_router_and_replicas(self):
+        router, servers, _ = make_fleet(n=2)
+        srcs = router.registry.fleet_sources()
+        # the router's own registry rides first: the fleet-level cost
+        # accounting (door hits/sheds, hedges) lives there
+        assert list(srcs) == ["router", "r0", "r1"]
+        assert srcs["router"] is router.registry
+        assert srcs["r0"] is servers[0].registry
+        # replicas can answer /fleet/* too (whoever owns the http port)
+        assert servers[1].registry.fleet_sources() == srcs
+
+    def test_fleet_sources_dedupe_shared_registry(self):
+        """bench --serve-replicas wiring: router and replicas sharing
+        ONE registry must merge as one source, not N copies (a /fleet
+        scrape would otherwise report every counter at Nx truth)."""
+        shared = Registry()
+        servers = [FakeReplicaServer(registry=shared) for _ in range(3)]
+        router = FleetRouter(servers, HParams(serve_replicas=3),
+                             registry=shared, clock=_Clock().now)
+        shared.counter("serve/completed_total").inc(5)
+        srcs = router.registry.fleet_sources()
+        assert list(srcs) == ["router"]
+        from textsummarization_on_flink_tpu.obs.registry import (
+            merge_fleet_snapshot,
+        )
+
+        snap = merge_fleet_snapshot(srcs)
+        assert snap["metrics"]["serve/completed_total"]["value"] == 5.0
+
+    def test_request_events_carry_replica_tag(self):
+        from textsummarization_on_flink_tpu.obs.export import MemorySink
+
+        _, servers, _ = make_fleet(n=2)
+        reg = servers[0].registry
+        sink = MemorySink()
+        reg.event_sink = sink
+        obs.spans.request_event(reg, "enqueue", None, "u1")
+        (rec,) = sink.records()
+        assert rec["replica"] == "r0"
+
+    def test_fleet_metrics_merge_sums_replica_counters(self):
+        router, servers, _ = make_fleet(n=2)
+        servers[0].registry.counter("serve/completed_total").inc(2)
+        servers[1].registry.counter("serve/completed_total").inc(5)
+        from textsummarization_on_flink_tpu.obs.registry import (
+            merge_fleet_snapshot,
+        )
+
+        snap = merge_fleet_snapshot(router.registry.fleet_sources())
+        assert snap["metrics"]["serve/completed_total"]["value"] == 7.0
+
+    def test_hedge_spend_labeled_by_tenant(self):
+        clock = _Clock()
+        router, servers, _ = make_fleet(2, hedge_ms=50.0, ratio=1.0,
+                                        clock=clock)
+        fut = router.submit("a", uuid="u0", tenant="acme")
+        clock.t = 0.1
+        router.tick()
+        reg = router.registry
+        assert reg.counter("serve/hedges_total").labels(
+            tenant="acme").value == 1
+        twin = [s for s in servers if s.submits][-1]
+        twin.resolve("u0", result="twin")
+        assert fut.result(timeout=1) == "twin"
+        assert reg.counter("serve/hedge_wins_total").labels(
+            tenant="acme").value == 1
+        # the unlabeled totals keep their historical meaning (roll-up)
+        assert reg.counter("serve/hedges_total").value == 1
+
+    def test_fleet_requests_total_labeled(self):
+        router, servers, _ = make_fleet(n=2)
+        router.submit("a", uuid="u0", tenant="acme", tier="greedy")
+        c = router.registry.counter("serve/requests_total")
+        assert c.labels(tenant="acme", tier="greedy").value == 1
+        assert c.value == 1
+
+    def test_fleet_shed_feeds_slo_burn_windows(self):
+        """A fleet-ingress shed (tenant throttle, every replica full)
+        is a BAD event for the SLO burn windows.  The router owns the
+        fleet's ingress tracking (replica tracking is disabled), so
+        without this a total admission outage at the fleet front door
+        — the exact outage the engine pages on — would read as a
+        healthy SLO."""
+        from textsummarization_on_flink_tpu.obs import slo as slo_lib
+        from textsummarization_on_flink_tpu.serve.errors import (
+            TenantThrottledError,
+        )
+
+        clock = _Clock()
+        reg = Registry()
+        pol = {"windows": {"fast_secs": 10.0, "slow_secs": 100.0},
+               "objectives": [{"name": "lat", "signal": "latency",
+                               "by": "tenant",
+                               "latency_threshold_ms": 1000.0,
+                               "target": 0.9}]}
+        slo_lib.install_slo_engine(reg, policy=pol, clock=clock.now)
+        router, servers, _ = make_fleet(2, clock=clock, registry=reg,
+                                        serve_tenant_rate=1.0,
+                                        serve_tenant_burst=1)
+        bad = reg.counter("slo/bad_total")
+        router.submit("a", uuid="u0", tenant="evil")  # spends the burst
+        with pytest.raises(TenantThrottledError):
+            router.submit("a", uuid="u1", tenant="evil")
+        assert bad.labels(objective="lat", key="evil").value == 1
+        for h in router.replicas():  # fleet-wide overload: no rotation
+            h.killed = True
+        with pytest.raises(ServeOverloadError):
+            router.submit("b", uuid="u2", tenant="evil")
+        assert bad.labels(objective="lat", key="evil").value == 2
+
+    def test_stop_retires_fleet_sources_everywhere(self):
+        """A stopped fleet must not pin its replicas in memory through
+        a long-lived registry nor keep answering /fleet/* with a dead
+        fleet's registries."""
+        router, servers, _ = make_fleet(n=2)
+        assert router.registry.fleet_sources is not None
+        router.stop()
+        assert router.registry.fleet_sources is None
+        assert all(s.registry.fleet_sources is None for s in servers)

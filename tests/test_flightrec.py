@@ -245,3 +245,63 @@ class TestHeartbeatRetire:
         assert rec.dump("train_nan") is None  # now genuinely spent
         assert reg.counter("obs/flight_dumps_dropped_total").value == 1
         shutil.rmtree(target, ignore_errors=True)
+
+
+class TestReplicaTagging:
+    """ISSUE 15 satellite: fleet replicas sharing one log directory
+    must not clobber or shadow each other's dumps — every frame and
+    dump filename carries the replica id."""
+
+    def test_frames_and_dump_filename_carry_replica_id(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), capacity=4,
+                                       registry=Registry(),
+                                       replica_id="r2")
+        rec.record("serve_tick", tick=1)
+        path = rec.dump("serve_dispatch", error="X")
+        assert path.endswith("flight_serve_dispatch.r2.jsonl")
+        lines = _read(path)
+        assert lines[0]["replica"] == "r2"
+        assert all(f["replica"] == "r2" for f in lines[1:])
+
+    def test_two_replicas_same_reason_distinct_files(self, tmp_path):
+        paths = set()
+        for rid in ("r0", "r2"):
+            rec = flightrec.FlightRecorder(str(tmp_path), capacity=2,
+                                           registry=Registry(),
+                                           replica_id=rid)
+            rec.record("serve_tick", tick=0)
+            paths.add(rec.dump("serve_dispatch"))
+        assert len(paths) == 2  # no clobber, no -2 shadow suffix
+        assert all(p and "flight_serve_dispatch." in p for p in paths)
+
+    def test_set_replica_id_reaches_installed_recorder(self, tmp_path):
+        reg = Registry()
+        rec = flightrec.install_flight_recorder(reg, str(tmp_path),
+                                                capacity=4)
+        flightrec.set_replica_id(reg, "r7")
+        assert reg.replica_id == "r7"
+        assert rec.replica_id == "r7"
+        flightrec.record(reg, "serve_tick", tick=1)
+        path = flightrec.trigger(reg, "replica_kill")
+        assert path.endswith("flight_replica_kill.r7.jsonl")
+        assert _read(path)[1]["replica"] == "r7"
+
+    def test_untagged_recorder_unchanged(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), capacity=2,
+                                       registry=Registry())
+        rec.record("serve_tick", tick=0)
+        path = rec.dump("serve_dispatch")
+        assert path.endswith("flight_serve_dispatch.jsonl")
+        assert "replica" not in _read(path)[1]
+
+    def test_hostile_replica_id_sanitized_in_filename(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), capacity=2,
+                                       registry=Registry(),
+                                       replica_id="../evil id")
+        rec.record("serve_tick", tick=0)
+        path = rec.dump("x")
+        # no path separators survive into the filename fragment: a
+        # hostile id cannot traverse out of the log directory
+        fragment = path.rsplit("flight_", 1)[1]
+        assert "/" not in fragment and " " not in fragment
+        assert path.startswith(str(tmp_path))

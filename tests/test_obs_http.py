@@ -276,3 +276,104 @@ class TestGating:
             assert status == 200
         finally:
             srv.close()
+
+
+class TestIssue15Endpoints:
+    """/alerts, /exemplars, /fleet/* and the /snapshot health_info ride
+    (ISSUE 15)."""
+
+    def test_snapshot_carries_health_info(self, served):
+        reg, srv = served
+        reg.counter("serve/completed_total").inc()
+        obs_http.set_health_info(reg, serve_mode="continuous",
+                                 params_fingerprint="abc123")
+        status, body = _get(srv.port, "/snapshot")
+        snap = json.loads(body)
+        assert snap["health_info"] == {"serve_mode": "continuous",
+                                       "params_fingerprint": "abc123"}
+        # metrics still ride alongside: one scrape, both facts
+        assert snap["serve/completed_total"]["value"] == 1.0
+
+    def test_snapshot_without_health_info_unchanged(self, served):
+        reg, srv = served
+        reg.counter("t/c").inc()
+        _, body = _get(srv.port, "/snapshot")
+        assert "health_info" not in json.loads(body)
+
+    def test_alerts_quiet_ok_without_engine(self, served):
+        _, srv = served
+        status, body = _get(srv.port, "/alerts")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["status"] == "ok" and not payload["installed"]
+
+    def test_alerts_reports_installed_engine(self, served):
+        from textsummarization_on_flink_tpu.obs import slo as slo_lib
+
+        reg, srv = served
+        pol = {"windows": {"fast_secs": 10.0, "slow_secs": 100.0},
+               "thresholds": {"warn": 2.0, "page": 10.0},
+               "objectives": [{"name": "lat", "signal": "latency",
+                               "by": "tenant",
+                               "latency_threshold_ms": 1000.0,
+                               "target": 0.9}]}
+        eng = slo_lib.install_slo_engine(reg, policy=pol)
+        eng.record("a", "beam", 5.0)  # every request bad -> page
+        eng.evaluate()  # the tick side computes; /alerts only reads
+        status, body = _get(srv.port, "/alerts")
+        payload = json.loads(body)
+        assert payload["installed"] and payload["status"] == "page"
+        (row,) = payload["objectives"]
+        assert row["key"] == "a" and row["state"] == "page"
+
+    def test_exemplars_endpoint(self, served):
+        reg, srv = served
+        reg.histogram("serve/e2e_latency_seconds",
+                      buckets=(1.0,)).observe(0.5, trace_id="tr-1")
+        status, body = _get(srv.port, "/exemplars")
+        assert status == 200
+        (row,) = json.loads(body)
+        assert row == {"metric": "serve/e2e_latency_seconds", "le": "1",
+                       "trace_id": "tr-1", "value": 0.5}
+
+    def test_fleet_routes_404_without_sources(self, served):
+        _, srv = served
+        status, body = _get(srv.port, "/fleet/metrics")
+        assert status == 404
+        assert "fleet" in json.loads(body)["error"]
+
+    def test_fleet_metrics_and_snapshot(self, served):
+        reg, srv = served
+        r0, r1 = Registry(), Registry()
+        r0.counter("serve/completed_total").inc(3)
+        r1.counter("serve/completed_total").inc(4)
+        r0.gauge("serve/queue_depth").set(2)
+        reg.fleet_sources = lambda: {"r0": r0, "r1": r1}
+        status, body = _get(srv.port, "/fleet/metrics")
+        assert status == 200
+        text = body.decode("utf-8")
+        assert "serve_completed_total 7" in text
+        assert 'serve_queue_depth{replica="r0"} 2' in text
+        status, body = _get(srv.port, "/fleet/snapshot")
+        snap = json.loads(body)
+        assert snap["replicas"] == ["r0", "r1"]
+        assert snap["metrics"]["serve/completed_total"]["value"] == 7.0
+
+    def test_metrics_exemplars_only_under_openmetrics_accept(self, served):
+        """Exemplar annotations are OpenMetrics syntax: a plain
+        Prometheus-0.0.4 scrape must not see them (a 0.0.4 parser
+        rejects the trailing `# {...}` and loses the whole scrape);
+        a negotiated scrape gets the annotated body verbatim."""
+        reg, srv = served
+        reg.histogram("t/h", buckets=(1.0,)).observe(0.5, trace_id="tr-9")
+        status, plain = _get(srv.port, "/metrics")
+        assert status == 200 and b"trace_id" not in plain
+        assert plain.decode("utf-8") == reg.render_text(exemplars=False)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert "openmetrics-text" in r.headers["Content-Type"]
+            body = r.read()
+        assert b'# {trace_id="tr-9"} 0.5' in body
+        assert body.decode("utf-8") == reg.render_text(openmetrics=True)

@@ -1,0 +1,304 @@
+"""Labeled metrics, trace exemplars, and the fleet aggregation plane
+(ISSUE 15 tentpole, pieces 1 and 3).
+
+Pins the load-bearing contracts:
+
+  * label-cardinality BOUNDS — a hostile stream of 10k distinct tenant
+    names cannot grow a metric's child map (LRU eviction, counted in
+    ``obs/label_evictions_total``) nor its rendered exposition;
+  * counter/histogram children ROLL UP into the unlabeled parent (the
+    aggregate survives eviction), gauges do not;
+  * histogram bucket exemplars (last trace_id per bucket) ride
+    ``render_text`` in OpenMetrics syntax and the /exemplars payload;
+  * fleet merge correctness — bucket-wise histogram sums across 3
+    registries equal the hand-computed merged exposition, counters sum,
+    gauges come back ``replica=``-labeled, and a bucket-layout mismatch
+    degrades to honest per-replica series, never a wrong sum.
+"""
+
+import math
+
+import numpy as np
+
+from textsummarization_on_flink_tpu.obs import http as obs_http
+from textsummarization_on_flink_tpu.obs.registry import (
+    Registry,
+    merge_fleet_series,
+    merge_fleet_snapshot,
+    render_fleet_text,
+)
+
+
+# --------------------------------------------------------------------------
+# labeled children: API, roll-up, identity
+# --------------------------------------------------------------------------
+
+class TestLabeledMetrics:
+    def test_counter_children_roll_up_into_parent(self):
+        r = Registry()
+        c = r.counter("serve/requests_total")
+        c.labels(tenant="a", tier="beam").inc(3)
+        c.labels(tenant="b", tier="beam").inc(2)
+        assert c.value == 5.0
+        assert c.labels(tenant="a", tier="beam").value == 3.0
+
+    def test_label_identity_is_order_insensitive(self):
+        r = Registry()
+        c = r.counter("t/c")
+        assert c.labels(a="1", b="2") is c.labels(b="2", a="1")
+        # different values are different series
+        assert c.labels(a="1") is not c.labels(a="2")
+
+    def test_gauge_children_do_not_roll_up(self):
+        r = Registry()
+        g = r.gauge("serve/queue_depth")
+        g.labels(replica="r0").set(4)
+        g.labels(replica="r1").set(7)
+        assert g.value == 0.0  # last-write-wins parents stay untouched
+        assert g.labels(replica="r1").value == 7.0
+
+    def test_labels_on_child_raises(self):
+        r = Registry()
+        c = r.counter("t/c")
+        child = c.labels(tenant="a")
+        try:
+            child.labels(tier="beam")
+        except ValueError as e:
+            assert "already-labeled" in str(e)
+        else:  # pragma: no cover
+            raise AssertionError("labels() on a child must raise")
+
+    def test_histogram_children_share_buckets_and_roll_up(self):
+        r = Registry()
+        h = r.histogram("t/h", buckets=(1.0, 2.0, 4.0))
+        h.labels(tier="beam").observe(1.5)
+        h.labels(tier="greedy").observe(3.0)
+        assert h.count == 2
+        assert h.sum == 4.5
+        assert h.labels(tier="beam").buckets == (1.0, 2.0, 4.0)
+        assert h.labels(tier="beam").count == 1
+
+    def test_snapshot_and_render_carry_children(self):
+        r = Registry()
+        r.counter("t/c").labels(tenant="a").inc()
+        snap = r.snapshot(compact=True)
+        assert snap['t/c{tenant="a"}']["value"] == 1.0
+        assert snap["t/c"]["value"] == 1.0  # rolled-up parent
+        text = r.render_text()
+        assert 't_c{tenant="a"} 1' in text
+
+    def test_label_values_escaped_in_exposition(self):
+        r = Registry()
+        r.counter("t/c").labels(tenant='ev"il\n').inc()
+        text = r.render_text()
+        assert 'tenant="ev\\"il\\n"' in text
+
+
+# --------------------------------------------------------------------------
+# cardinality bounds (ISSUE 15 satellite)
+# --------------------------------------------------------------------------
+
+class TestLabelCardinality:
+    def test_hostile_tenant_stream_is_lru_bounded(self):
+        r = Registry(max_label_sets=64)
+        c = r.counter("serve/tenant_shed_total")
+        for i in range(10_000):
+            c.labels(tenant=f"hostile-{i}").inc()
+        assert len(c.label_children()) == 64
+        # every inc rolled up before its child was evicted: aggregate
+        # truth survives the bound
+        assert c.value == 10_000.0
+        evicted = r.counter("obs/label_evictions_total").value
+        assert evicted == 10_000 - 64
+        # the newest names survive (LRU), the oldest are gone
+        survivors = {kv[0][1] for kv in
+                     (ch.labels_kv for ch in c.label_children())}
+        assert "hostile-9999" in survivors
+        assert "hostile-0" not in survivors
+
+    def test_render_stays_bounded_under_hostile_labels(self):
+        r = Registry(max_label_sets=32)
+        h = r.histogram("t/h", buckets=(1.0,))
+        for i in range(5_000):
+            h.labels(tenant=f"t{i}").observe(0.5)
+        text = r.render_text()
+        # 32 children * 4 lines (+inf bucket, 1.0 bucket, sum, count)
+        # + parent + TYPE lines + eviction counter: bounded, not 5k rows
+        assert len(text.splitlines()) < 200
+
+    def test_touch_refreshes_lru_position(self):
+        r = Registry(max_label_sets=2)
+        c = r.counter("t/c")
+        c.labels(t="a").inc()
+        c.labels(t="b").inc()
+        c.labels(t="a").inc()  # refresh a
+        c.labels(t="c").inc()  # evicts b, not a
+        names = {kv[0][1] for kv in
+                 (ch.labels_kv for ch in c.label_children())}
+        assert names == {"a", "c"}
+
+
+# --------------------------------------------------------------------------
+# trace exemplars
+# --------------------------------------------------------------------------
+
+class TestExemplars:
+    def test_bucket_exemplar_last_write_wins(self):
+        r = Registry()
+        h = r.histogram("serve/e2e_latency_seconds", buckets=(1.0, 10.0))
+        h.observe(0.5, trace_id="t-early")
+        h.observe(0.7, trace_id="t-late")
+        h.observe(5.0, trace_id="t-slow")
+        h.observe(3.0)  # untraced observations never clobber exemplars
+        exs = {e["le"]: e for e in h.exemplars()}
+        assert exs["1"]["trace_id"] == "t-late"
+        assert exs["10"]["trace_id"] == "t-slow"
+        assert exs["10"]["value"] == 5.0
+
+    def test_exemplars_render_in_openmetrics_syntax(self):
+        r = Registry()
+        h = r.histogram("t/h", buckets=(1.0,))
+        h.observe(0.5, trace_id="abc123")
+        text = r.render_text(openmetrics=True)
+        assert '# {trace_id="abc123"} 0.5' in text
+        # the DEFAULT render is a valid exposition in either format:
+        # 0.0.4 without negotiation carries no OpenMetrics annotations
+        assert "trace_id" not in r.render_text()
+
+    def test_child_exemplars_roll_up_to_parent(self):
+        r = Registry()
+        h = r.histogram("t/h", buckets=(1.0,))
+        h.labels(tier="beam").observe(0.5, trace_id="via-child")
+        assert h.exemplars()[0]["trace_id"] == "via-child"
+
+    def test_exemplars_endpoint_payload(self):
+        r = Registry()
+        h = r.histogram("serve/e2e_latency_seconds", buckets=(1.0,))
+        h.labels(tier="beam").observe(0.2, trace_id="deadbeef")
+        rows = obs_http.exemplars(r)
+        mets = {row["metric"] for row in rows}
+        assert "serve/e2e_latency_seconds" in mets
+        assert 'serve/e2e_latency_seconds{tier="beam"}' in mets
+        assert all(row["trace_id"] == "deadbeef" for row in rows)
+
+    def test_p99_bucket_exemplar_names_the_slow_request(self):
+        """The operator story: the exemplar of the bucket holding the
+        p99 names a request whose latency is in the tail."""
+        r = Registry()
+        h = r.histogram("t/h", buckets=(0.1, 1.0, 10.0))
+        for i in range(50):
+            h.observe(0.05, trace_id=f"fast-{i}")
+        h.observe(5.0, trace_id="the-straggler")
+        p99 = h.percentile(99)
+        fat = next(e for e in h.exemplars()
+                   if e["le"] == "+Inf" or float(e["le"]) >= p99)
+        assert fat["trace_id"] == "the-straggler"
+
+
+# --------------------------------------------------------------------------
+# fleet merge correctness (ISSUE 15 satellite)
+# --------------------------------------------------------------------------
+
+def _three_registries():
+    regs = {}
+    rng = np.random.RandomState(7)
+    for i, rid in enumerate(("r0", "r1", "r2")):
+        r = Registry()
+        r.counter("serve/completed_total").inc(10 * (i + 1))
+        r.counter("serve/completed_total").labels(tenant="a").inc(i + 1)
+        r.gauge("serve/queue_depth").set(i)
+        h = r.histogram("serve/e2e_latency_seconds",
+                        buckets=(0.1, 1.0, 10.0))
+        for v in rng.uniform(0.01, 12.0, size=20):
+            h.observe(float(v))
+        regs[rid] = r
+    return regs
+
+
+class TestFleetMerge:
+    def test_counters_sum_across_registries(self):
+        regs = _three_registries()
+        rows = {(n, kv): p for n, kv, k, p in merge_fleet_series(regs)
+                if k == "counter"}
+        # parent: 10+20+30 plus the rolled-up labeled incs 1+2+3
+        assert rows[("serve/completed_total", ())] == 66.0
+        assert rows[("serve/completed_total",
+                     (("tenant", "a"),))] == 6.0
+
+    def test_gauges_come_back_replica_labeled(self):
+        regs = _three_registries()
+        gauge_rows = [(kv, p) for n, kv, k, p in merge_fleet_series(regs)
+                      if k == "gauge" and n == "serve/queue_depth"]
+        assert ((("replica", "r1"),), 1.0) in gauge_rows
+        assert len(gauge_rows) == 3
+
+    def test_histogram_bucketwise_sum_matches_hand_computed(self):
+        regs = _three_registries()
+        merged = next(p for n, kv, k, p in merge_fleet_series(regs)
+                      if k == "histogram" and kv == ())
+        hand = [0] * 4
+        total, vsum = 0, 0.0
+        vmin, vmax = math.inf, -math.inf
+        for r in regs.values():
+            s = r.get("serve/e2e_latency_seconds").snapshot()
+            for j, c in enumerate(s["counts"]):
+                hand[j] += c
+            total += s["count"]
+            vsum += s["sum"]
+            vmin = min(vmin, s["min"])
+            vmax = max(vmax, s["max"])
+        assert merged["counts"] == hand
+        assert merged["count"] == total == 60
+        assert abs(merged["sum"] - vsum) < 1e-9
+        assert merged["min"] == vmin and merged["max"] == vmax
+
+    def test_merged_exposition_equals_one_registry_seeing_all(self):
+        """The committed merge semantics: the fleet exposition is what
+        ONE registry observing every replica's stream would render."""
+        regs = _three_registries()
+        one = Registry()
+        one.counter("serve/completed_total").inc(60)
+        one.counter("serve/completed_total").labels(tenant="a").inc(6)
+        h = one.histogram("serve/e2e_latency_seconds",
+                          buckets=(0.1, 1.0, 10.0))
+        rng = np.random.RandomState(7)
+        for _ in range(3):
+            for v in rng.uniform(0.01, 12.0, size=20):
+                h.observe(float(v))
+        fleet_text = render_fleet_text(regs)
+        for line in fleet_text.splitlines():
+            if line.startswith("serve_e2e_latency_seconds_bucket"):
+                assert line in one.render_text(), line
+
+    def test_layout_mismatch_degrades_to_per_replica_series(self):
+        ra, rb = Registry(), Registry()
+        ra.histogram("t/h", buckets=(1.0, 2.0)).observe(0.5)
+        rb.histogram("t/h", buckets=(5.0,)).observe(0.5)
+        rows = [(kv, p) for n, kv, k, p in
+                merge_fleet_series({"a": ra, "b": rb})
+                if k == "histogram"]
+        assert len(rows) == 2
+        labels = {kv for kv, _ in rows}
+        assert labels == {(("replica", "a"),), (("replica", "b"),)}
+
+    def test_fleet_snapshot_percentiles_over_merged_buckets(self):
+        regs = _three_registries()
+        snap = merge_fleet_snapshot(regs)
+        assert snap["replicas"] == ["r0", "r1", "r2"]
+        m = snap["metrics"]["serve/e2e_latency_seconds"]
+        assert m["count"] == 60
+        assert m["min"] <= m["p50"] <= m["p99"] <= m["max"]
+        assert snap["metrics"]["serve/completed_total"]["value"] == 66.0
+
+    def test_fleet_snapshot_carries_replica_health(self):
+        regs = _three_registries()
+        obs_http.set_health_info(regs["r1"], serve_mode="continuous")
+        snap = merge_fleet_snapshot(regs)
+        assert snap["health"] == {"r1": {"serve_mode": "continuous"}}
+
+    def test_already_replica_labeled_gauge_not_double_tagged(self):
+        r = Registry()
+        r.gauge("t/g").labels(replica="self").set(1.0)
+        rows = [(kv, p) for n, kv, k, p in
+                merge_fleet_series({"rX": r}) if k == "gauge"]
+        assert rows == [((("replica", "self"),), 1.0)]

@@ -189,8 +189,9 @@ def serve_http(port: int, reg: Optional[Registry] = None):
         reg if reg is not None else registry(), port=port).start()
 
 
-def render_text() -> str:
-    return registry().render_text()
+def render_text(exemplars: Optional[bool] = None,
+                openmetrics: bool = False) -> str:
+    return registry().render_text(exemplars=exemplars, openmetrics=openmetrics)
 
 
 def snapshot(compact: bool = False) -> Dict[str, Dict]:

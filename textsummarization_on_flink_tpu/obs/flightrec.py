@@ -79,15 +79,23 @@ def _dumps(rec: Dict[str, Any]) -> str:
 
 
 class FlightRecorder:
-    """Bounded ring of structured frames + triggered JSONL dumps."""
+    """Bounded ring of structured frames + triggered JSONL dumps.
+
+    ``replica_id`` (ISSUE 15 satellite) tags every frame and dump
+    filename when set — fleet replicas sharing one log directory write
+    ``flight_<reason>.<replica>.jsonl``, so replica 2's serve-dispatch
+    dump can never clobber or shadow replica 0's.  Threaded from
+    FleetRouter replica construction via ``set_replica_id``."""
 
     def __init__(self, directory: str, capacity: int = DEFAULT_CAPACITY,
                  registry: Optional[Registry] = None,
-                 max_dumps_per_reason: int = DEFAULT_MAX_DUMPS_PER_REASON):
+                 max_dumps_per_reason: int = DEFAULT_MAX_DUMPS_PER_REASON,
+                 replica_id: str = ""):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.directory = directory
         self.capacity = capacity
+        self.replica_id = _safe_reason(replica_id) if replica_id else ""
         self._frames: "collections.deque[dict]" = collections.deque(
             maxlen=capacity)
         self._lock = threading.Lock()
@@ -113,6 +121,8 @@ class FlightRecorder:
                      # serialized epoch timestamp, same dialect as span
                      # ts_us (the sanctioned time.time() use, spans.py)
                      "ts_us": int(time.time() * 1e6)}
+            if self.replica_id:
+                frame["replica"] = self.replica_id
             frame.update(fields)
             self._frames.append(frame)
 
@@ -134,14 +144,19 @@ class FlightRecorder:
                 return None
             n = self._dump_attempts.get(reason, 0) + 1
             self._dump_attempts[reason] = n
-        name = (f"flight_{reason}.jsonl" if n == 1
-                else f"flight_{reason}-{n}.jsonl")
+        # the replica tag keeps fleet replicas sharing one directory
+        # from clobbering/shadowing each other's dumps (ISSUE 15)
+        stem = (f"flight_{reason}.{self.replica_id}" if self.replica_id
+                else f"flight_{reason}")
+        name = f"{stem}.jsonl" if n == 1 else f"{stem}-{n}.jsonl"
         path = os.path.join(self.directory, name)
         header: Dict[str, Any] = {
             "kind": "flight", "reason": reason, "dump": n,
             "ts_us": int(time.time() * 1e6), "frames": len(frames),
             "capacity": self.capacity,
         }
+        if self.replica_id:
+            header["replica"] = self.replica_id
         if context:
             header["context"] = context
         try:
@@ -176,8 +191,19 @@ def install_flight_recorder(registry: Registry, directory: str,
         with _install_lock:
             if registry.flight is None:
                 registry.flight = FlightRecorder(
-                    directory, capacity=capacity, registry=registry)
+                    directory, capacity=capacity, registry=registry,
+                    replica_id=getattr(registry, "replica_id", ""))
     return registry.flight
+
+
+def set_replica_id(registry: Registry, replica_id: str) -> None:
+    """Stamp `registry` (and any already-installed recorder) with the
+    fleet replica id its frames/dumps — and request events — should
+    carry (threaded from FleetRouter replica construction)."""
+    registry.replica_id = replica_id
+    rec = registry.flight
+    if rec is not None:
+        rec.replica_id = _safe_reason(replica_id) if replica_id else ""
 
 
 def record(registry: Registry, kind: str, **fields: Any) -> None:

@@ -20,9 +20,22 @@ Endpoints (all GET, all read-only):
     the HTTP status to 503 (load balancers understand).  Breaker states
     are reported but informational — see health() for why 503-ing an
     open admission breaker would pin it open;
-  * ``/snapshot`` — ``registry.snapshot(compact=True)`` as JSON;
+  * ``/snapshot`` — ``registry.snapshot(compact=True)`` as JSON, plus
+    the registry's ``health_info`` facts under a ``health_info`` key
+    (ISSUE 15 satellite: one scrape carries metrics + health context);
   * ``/spans``    — the newest buffered spans as unified event records
-    (``?n=<count>``, default 200).
+    (``?n=<count>``, default 200);
+  * ``/alerts``   — the SLO burn-rate engine's per-objective states as
+    of the last dispatch-tick evaluation (obs/slo.py ``alerts_payload``
+    — read-only like every route here; a quiet ok when none is
+    installed);
+  * ``/exemplars`` — every histogram's stamped per-bucket trace
+    exemplars as JSON (the ``--request <trace_id>`` jump-off point);
+  * ``/fleet/metrics`` + ``/fleet/snapshot`` — the merged fleet view
+    over the registries ``registry.fleet_sources`` names (wired by the
+    FleetRouter; 404 with a hint on a fleetless registry): counters
+    summed, gauges ``replica=``-labeled, histograms bucket-merged
+    (obs/registry.py ``render_fleet_text`` / ``merge_fleet_snapshot``).
 
 Staleness is computed from each component's own declared period (stale
 = age > STALE_FACTOR * period) on the injectable monotonic clock, so
@@ -40,8 +53,14 @@ import time
 import urllib.parse
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from textsummarization_on_flink_tpu.obs import slo as slo_lib
 from textsummarization_on_flink_tpu.obs import spans as spans_lib
-from textsummarization_on_flink_tpu.obs.registry import Registry
+from textsummarization_on_flink_tpu.obs.registry import (
+    Registry,
+    _series_key,
+    merge_fleet_snapshot,
+    render_fleet_text,
+)
 
 log = logging.getLogger(__name__)
 
@@ -207,6 +226,19 @@ def health(registry: Registry,
     return payload
 
 
+def exemplars(registry: Registry) -> list:
+    """The /exemplars payload: every histogram series' stamped
+    per-bucket trace exemplars — [{metric, le, trace_id, value}], the
+    machine-readable side of the OpenMetrics ``# {trace_id=...}``
+    annotations /metrics renders (ISSUE 15: ``scripts/trace_summary.py
+    --request <trace_id>`` turns any row into a full request
+    timeline)."""
+    return [{"metric": _series_key(name, labels_kv), **ex}
+            for name, labels_kv, kind, payload in registry.series()
+            if kind == "histogram"
+            for ex in payload["exemplars"]]
+
+
 class _Handler(http.server.BaseHTTPRequestHandler):
     """Routes the four endpoints over the registry the server wraps."""
 
@@ -238,14 +270,36 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         reg = self.registry
         try:
             if route == "/metrics":
-                self._send(200, reg.render_text().encode("utf-8"),
-                           content_type="text/plain; version=0.0.4")
+                # exemplar annotations are OPENMETRICS syntax — a
+                # Prometheus text-format (0.0.4) parser rejects the
+                # trailing `# {...}` as an invalid timestamp and fails
+                # the whole scrape, so the annotated body is served
+                # only to scrapers whose Accept header negotiates it
+                # (/exemplars carries the same data as JSON regardless)
+                openmetrics = "openmetrics" in (
+                    self.headers.get("Accept") or "")
+                self._send(
+                    200,
+                    reg.render_text(exemplars=openmetrics,
+                                    openmetrics=openmetrics).encode("utf-8"),
+                    content_type=(
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8" if openmetrics
+                        else "text/plain; version=0.0.4"))
             elif route == "/healthz":
                 payload = health(reg)
                 self._send_json(200 if payload["status"] == "ok" else 503,
                                 payload)
             elif route == "/snapshot":
-                self._send_json(200, reg.snapshot(compact=True))
+                snap: Dict[str, Any] = reg.snapshot(compact=True)
+                # ISSUE 15 satellite: the PR-13 routing inputs
+                # (serve_mode, params_fingerprint, replica, ...) ride
+                # the snapshot so one scrape carries metrics + health
+                # context together
+                info = getattr(reg, "health_info", None)
+                if info:
+                    snap["health_info"] = dict(info)
+                self._send_json(200, snap)
             elif route == "/spans":
                 qs = urllib.parse.parse_qs(parsed.query)
                 try:
@@ -255,10 +309,30 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 recs = spans_lib.tracer_for(reg).finished() if reg.enabled \
                     else []
                 self._send_json(200, [r.as_event() for r in recs[-n:]])
+            elif route == "/alerts":
+                self._send_json(200, slo_lib.alerts_payload(reg))
+            elif route == "/exemplars":
+                self._send_json(200, exemplars(reg))
+            elif route in ("/fleet/metrics", "/fleet/snapshot"):
+                sources = getattr(reg, "fleet_sources", None)
+                if sources is None:
+                    self._send_json(404, {
+                        "error": "no fleet behind this registry (the "
+                                 "FleetRouter wires registry."
+                                 "fleet_sources)"})
+                elif route == "/fleet/metrics":
+                    self._send(200,
+                               render_fleet_text(sources()).encode("utf-8"),
+                               content_type="text/plain; version=0.0.4")
+                else:
+                    self._send_json(200, merge_fleet_snapshot(sources()))
             else:
                 self._send_json(404, {"error": f"no route {route!r}",
                                       "routes": ["/metrics", "/healthz",
-                                                 "/snapshot", "/spans"]})
+                                                 "/snapshot", "/spans",
+                                                 "/alerts", "/exemplars",
+                                                 "/fleet/metrics",
+                                                 "/fleet/snapshot"]})
         except Exception:  # tslint: disable=TS005 — exposition must never kill the scrape thread; failures are counted and answered with a 500
             reg.counter("obs/http_errors_total").inc()
             log.exception("obs-http handler failed for %s", self.path)

@@ -354,6 +354,12 @@ def request_event(registry: Registry, event: str,
         "ts_us": int(time.time() * 1e6),
         "pid": os.getpid(),
     }
+    # fleet identity (ISSUE 15): a replica-tagged registry stamps its
+    # id on every lifecycle event, so one events.jsonl shared by N
+    # replicas reads as a self-describing cross-replica timeline
+    rid = getattr(registry, "replica_id", "")
+    if rid:
+        rec["replica"] = rid
     if ctx is not None:
         rec.update(ctx.as_dict())
     if attrs:

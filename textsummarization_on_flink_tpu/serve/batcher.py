@@ -257,6 +257,9 @@ class ContinuousBatcher:
         self._h_e2e = reg.histogram("serve/e2e_latency_seconds")
         self._c_done = reg.counter("serve/completed_total")
         self._c_errors = reg.counter("serve/errors_total")
+        # per-tenant cost accounting (ISSUE 15): decoded tokens charged
+        # to the tenant whose request occupied the slot
+        self._c_tenant_tokens = reg.counter("serve/tenant_tokens_total")
 
     def busy(self) -> bool:
         return any(r is not None for r in self._resident)
@@ -461,7 +464,16 @@ class ContinuousBatcher:
             res = self._engine.unpack(idx, req.example)
             self._resident[idx] = None
             self._h_resident.observe(self._chunks[idx])
-            self._h_e2e.observe(done_t - req.enqueue_t)
+            # exemplar (ISSUE 15): the landing latency bucket remembers
+            # THIS request's trace_id, so a fat p99 bucket on /metrics
+            # names a concrete uuid to chase
+            self._h_e2e.observe(
+                done_t - req.enqueue_t,
+                trace_id=req.trace.trace_id if req.trace is not None
+                else None)
+            self._c_tenant_tokens.labels(
+                tenant=req.tenant or "default").inc(
+                len(getattr(res, "decoded_words", ()) or ()))
             self._c_done.inc()
             obs.spans.request_event(
                 self._reg, "finish", req.trace, req.uuid, slot=idx,

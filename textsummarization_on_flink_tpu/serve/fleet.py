@@ -68,6 +68,8 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
 
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.obs import flightrec
+from textsummarization_on_flink_tpu.obs import http as obs_http
+from textsummarization_on_flink_tpu.obs import slo as slo_lib
 from textsummarization_on_flink_tpu.resilience import faultinject
 from textsummarization_on_flink_tpu.serve.errors import (
     ReplicaKilledError,
@@ -75,7 +77,11 @@ from textsummarization_on_flink_tpu.serve.errors import (
     ServeOverloadError,
 )
 from textsummarization_on_flink_tpu.serve.frontdoor import FrontDoor
-from textsummarization_on_flink_tpu.serve.queue import ServeFuture
+from textsummarization_on_flink_tpu.serve.queue import (
+    ServeFuture,
+    track_rejection,
+    track_request,
+)
 from textsummarization_on_flink_tpu.serve.router import (
     ReplicaHandle,
     fleet_fingerprint,
@@ -231,6 +237,22 @@ class FleetRouter:
                               clock=clock, reset_secs=replica_reset_secs)
             self._handles[rid] = h
             self._handle_list.append(h)
+            # fleet identity (ISSUE 15 satellite): stamp the replica id
+            # on each replica's registry so its request events and
+            # flight-recorder frames/dump FILENAMES carry it — replica
+            # 2's flight_serve_dispatch dump can never clobber or
+            # shadow replica 0's in a shared log directory
+            rreg = server.registry
+            if rreg.enabled and rreg is not self._reg:
+                flightrec.set_replica_id(rreg, rid)
+            if hasattr(server, "disable_ingress_tracking"):
+                # behind a router the ROUTER future is the one
+                # caller-visible request: a replica also tracking each
+                # routed/hedged/requeued attempt would double-count
+                # serve/requests_total and the SLO burn windows
+                # (directly on shared-registry wiring, or through the
+                # /fleet/* merge on per-replica registries)
+                server.disable_ingress_tracking()
         # hedging knobs, precomputed (the scan is a hot loop)
         self._hedge_s = max(0.0, float(
             getattr(hps, "serve_hedge_ms", 0.0))) / 1000.0
@@ -264,6 +286,7 @@ class FleetRouter:
         # fleet telemetry (OBSERVABILITY.md; rotation breakers ride the
         # resilience/* wildcard family)
         self._c_submitted = self._reg.counter("serve/fleet_submitted_total")
+        self._c_requests = self._reg.counter("serve/requests_total")
         self._c_hedges = self._reg.counter("serve/hedges_total")
         self._c_hedge_wins = self._reg.counter("serve/hedge_wins_total")
         self._c_hedge_suppressed = self._reg.counter(
@@ -291,6 +314,24 @@ class FleetRouter:
                 rreg = h.server.registry
                 if rreg.enabled and rreg.event_sink is None:
                     rreg.event_sink = sink
+        # the fleet aggregation plane (ISSUE 15 tentpole, piece 3):
+        # /fleet/metrics and /fleet/snapshot merge over this ordered
+        # {replica_id: Registry} map — wired onto the router's registry
+        # AND every replica's, so whichever registry happens to own the
+        # process exposition port (obs_http.maybe_serve is first-caller
+        # -wins and replicas construct before the router) can answer
+        if self._reg.enabled:
+            self._reg.fleet_sources = self._fleet_registries
+            for h in self._handle_list:
+                rreg = h.server.registry
+                if rreg.enabled and rreg.fleet_sources is None:
+                    rreg.fleet_sources = self._fleet_registries
+            obs_http.maybe_serve(self._reg, hps)
+        # per-tenant/per-tier SLO burn-rate engine at the FLEET ingress
+        # (obs/slo.py): the router-level future is the caller-visible
+        # exactly-once resolution, so latency/error classification
+        # happens here, over the router's (possibly virtual) clock
+        slo_lib.install_slo_engine(self._reg, clock=clock)
 
     # -- lifecycle --
     def start(self) -> "FleetRouter":
@@ -332,6 +373,18 @@ class FleetRouter:
         if leftovers:  # pragma: no cover - defensive backstop
             log.warning("fleet stop settled %d unresolved request(s) "
                         "typed", leftovers)
+        # retire the /fleet/* source map everywhere it was wired: a
+        # stopped fleet must not pin its replicas (and their decoders)
+        # in memory through a long-lived registry, nor keep answering
+        # scrapes with a dead fleet's registries
+        for reg in (self._reg, *(h.server.registry
+                                 for h in self._handle_list)):
+            # == not `is`: a bound method is minted per attribute
+            # access, but compares equal on (func, self) — which is
+            # exactly "wired by THIS router"
+            if getattr(reg, "fleet_sources", None) == \
+                    self._fleet_registries:
+                reg.fleet_sources = None
 
     def __enter__(self) -> "FleetRouter":
         return self.start()
@@ -344,6 +397,42 @@ class FleetRouter:
         helper ``router.fleet_fingerprint`` over this fleet's handles
         (None while live replicas disagree mid-swap: lookups go dark)."""
         return fleet_fingerprint(self._handle_list)
+
+    def _fleet_registries(self) -> Dict[str, obs.Registry]:
+        """The ordered {id: Registry} map the /fleet/* merge runs over
+        (obs/registry.py merge_fleet_series).  The router's own
+        registry rides first under ``router`` — in fleet mode the front
+        door (and therefore the per-tenant hit/shed/hedge cost
+        accounting) is router-owned, and a /fleet/snapshot audit that
+        showed tenant spend but never tenant savings would lie.  Dead
+        replicas stay listed: their counters are history the fleet view
+        must keep summing, and their gauges stop updating honestly.
+        Registries are deduplicated by IDENTITY: under shared-registry
+        wiring (bench --serve-replicas shares ONE process registry
+        across router and replicas) the merge must count each series
+        once, not once per replica id."""
+        out: Dict[str, obs.Registry] = {}
+        seen = set()
+        if self._reg.enabled:
+            out["router"] = self._reg
+            seen.add(id(self._reg))
+        for h in self._handle_list:
+            reg = h.server.registry
+            if id(reg) in seen:
+                continue
+            seen.add(id(reg))
+            out[h.rid] = reg
+        return out
+
+    def _track_request(self, fut: ServeFuture, tenant: str,
+                       tier: str) -> ServeFuture:
+        """Fleet-ingress accounting for one caller-visible future — the
+        shared ``queue.track_request`` helper over the ROUTER future,
+        so hedges/requeues resolve into one recorded outcome (replica
+        ingress tracking is disabled at construction)."""
+        track_request(self._reg, self._clock, fut, tenant, tier,
+                      counter=self._c_requests)
+        return fut
 
     # -- request API --
     def submit(self, article: str, uuid: str = "", reference: str = "",
@@ -379,8 +468,18 @@ class FleetRouter:
         tier = tier or getattr(self._hps, "serve_default_tier", "beam")
         flight = None
         if self._door.armed:
-            self._door.admit_tenant(tenant, uuid)
-            kind, val = self._door.open(article, tier, uuid, reference)
+            try:
+                self._door.admit_tenant(tenant, uuid)
+            except ServeOverloadError:
+                # a fleet-ingress shed is a BAD event for the SLO burn
+                # windows, exactly like the standalone server's: the
+                # router owns ingress tracking (replica tracking is
+                # disabled), so without this a tenant-throttle outage
+                # at the fleet front door reads as a healthy SLO
+                track_rejection(self._reg, tenant, tier)
+                raise
+            kind, val = self._door.open(article, tier, uuid, reference,
+                                        tenant=tenant)
             if kind in ("hit", "follower"):
                 # hits and followers ARE fleet admissions (the counter's
                 # documented meaning, and the hedge waste cap's
@@ -389,7 +488,7 @@ class FleetRouter:
                 with self._lock:
                     self._n_submitted += 1
                 self._c_submitted.inc()
-                return val
+                return self._track_request(val, tenant, tier)
             if kind == "leader":
                 flight = val
         ctx = obs.TraceContext.new() if self._reg.enabled else None
@@ -427,6 +526,11 @@ class FleetRouter:
             # would attach to a leader that never existed and hang
             if flight is not None:
                 self._door.abort(flight, e)
+            if isinstance(e, ServeOverloadError):
+                # every replica full (or a typed overload verdict): a
+                # caller-visible shed, fed to the burn windows at the
+                # ingress that owns this request's tracking
+                track_rejection(self._reg, tenant, tier)
             raise
         with self._lock:
             self._inflight.append(routed)
@@ -434,7 +538,7 @@ class FleetRouter:
         if flight is not None:
             self._door.commit(flight, future)
         self._c_submitted.inc()
-        return future
+        return self._track_request(future, tenant, tier)
 
     def _attempt(self, routed: _Routed, handle: ReplicaHandle,
                  hedge: bool = False, block: bool = False,
@@ -475,7 +579,8 @@ class FleetRouter:
         if err is None:
             if routed.offer_result(fut.result()):
                 if hedge:
-                    self._c_hedge_wins.inc()
+                    self._c_hedge_wins.labels(
+                        tenant=routed.tenant or "default").inc()
             return
         if isinstance(err, ReplicaKilledError) and self._requeue(
                 routed, handle, err):
@@ -515,6 +620,10 @@ class FleetRouter:
         the router thread in production, or directly by deterministic
         harnesses (the fleet SLO gate) — same code either way."""
         self._maybe_chaos_kill()
+        # burn-rate refresh once per router round: alert transitions
+        # (and the slo_burn flight dump) fire on the router tick,
+        # deterministically under the virtual-time gate
+        slo_lib.evaluate(self._reg)
         for rid, what in refresh_rotation(self._handle_list):
             log.warning("replica %s %s rotation", rid,
                         "removed from" if what == "removed" else
@@ -684,7 +793,9 @@ class FleetRouter:
                 waited_ms=round((now - routed.submit_t) * 1000.0, 3))
             with self._lock:
                 self._n_hedges += 1
-            self._c_hedges.inc()
+            # per-tenant hedge spend (ISSUE 15 cost accounting): waste
+            # per tenant = hedges - hedge wins on these labeled children
+            self._c_hedges.labels(tenant=routed.tenant or "default").inc()
 
     # -- introspection --
     def replicas(self) -> List[ReplicaHandle]:

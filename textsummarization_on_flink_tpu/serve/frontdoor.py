@@ -320,6 +320,12 @@ class FrontDoor:
         self._c_coalesced = self._reg.counter("serve/coalesced_total")
         self._c_tenant_shed = self._reg.counter("serve/tenant_shed_total")
         self._c_cache_errors = self._reg.counter("serve/cache_errors_total")
+        # per-tenant cost accounting (ISSUE 15): decode tokens a tenant
+        # did NOT pay for because the cache answered — the savings side
+        # of serve/tenant_tokens_total, so the fairness story is
+        # auditable per tenant on /fleet/snapshot
+        self._c_tokens_saved = self._reg.counter(
+            "serve/tenant_tokens_saved_total")
 
     # -- tenant admission --
     def admit_tenant(self, tenant: str, uuid: str = "") -> None:
@@ -350,7 +356,9 @@ class FrontDoor:
                 self._tenants.move_to_end(tenant)
             ok = bucket.take(now)
         if not ok:
-            self._c_tenant_shed.inc()
+            # labeled child rolls up into the unlabeled total: WHO is
+            # being throttled is the per-tenant fairness evidence
+            self._c_tenant_shed.labels(tenant=tenant or "default").inc()
             obs.spans.request_event(self._reg, "tenant_shed", None, uuid,
                                     tenant=tenant)
             raise TenantThrottledError(
@@ -390,11 +398,15 @@ class FrontDoor:
             log.exception("summary-cache insert failed; entry dropped")
 
     def open(self, article: str, tier: str, uuid: str, reference: str,
-             trace: Optional[Any] = None) -> Tuple[str, Any]:
+             trace: Optional[Any] = None,
+             tenant: str = "") -> Tuple[str, Any]:
         """Route one submit through the front door.  `trace` is the
         caller's externally-minted TraceContext, if any — a hit's or
         follower's events must land under the SAME trace the caller's
-        route events use, not a fresh one.  Returns one of
+        route events use, not a fresh one.  `tenant` labels the
+        hit/coalesce accounting (ISSUE 15: whose decode cost was
+        avoided), never the cache key — summaries are shared across
+        tenants by design.  Returns one of
 
           * ``("pass", None)`` — nothing armed; submit normally;
           * ``("hit", future)`` — summary cache hit: the future is
@@ -413,7 +425,10 @@ class FrontDoor:
             if fp is not None:
                 cached = self._cache_get((key, tier, fp))
                 if cached is not None:
-                    self._c_hits.inc()
+                    self._c_hits.labels(tenant=tenant or "default").inc()
+                    self._c_tokens_saved.labels(
+                        tenant=tenant or "default").inc(
+                        len(getattr(cached, "decoded_words", ()) or ()))
                     fut = self._make_future(uuid, trace)
                     obs.spans.request_event(
                         self._reg, "cache_hit", fut.trace, uuid,
@@ -449,7 +464,7 @@ class FrontDoor:
                 self._reg, "coalesced", fut.trace, uuid,
                 leader=flight.leader_uuid, key=key, tier=tier)
             flight.followers.append((uuid, article, reference, fut))
-        self._c_coalesced.inc()
+        self._c_coalesced.labels(tenant=tenant or "default").inc()
         return "follower", fut
 
     def _make_future(self, uuid: str,

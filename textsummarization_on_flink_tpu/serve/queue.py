@@ -57,6 +57,50 @@ log = logging.getLogger(__name__)
 MAX_TENANT_CREDITS = 4096
 
 
+def track_request(registry: "obs.Registry", clock: Callable[[], float],
+                  fut: "ServeFuture", tenant: str, tier: str,
+                  counter: Optional["obs.registry.Counter"] = None) -> None:
+    """Ingress-side accounting for ONE admitted future (ISSUE 15): the
+    labeled ``serve/requests_total{tenant,tier}`` child (rolls up into
+    the unlabeled total), and — when an SLO engine is installed on
+    `registry` — a done-callback classifying (tenant, tier, latency,
+    error) into the burn-rate windows on the future's exactly-once
+    resolution.  Latency runs on the CALLER's clock (virtual in the
+    committed gate).  The one helper both ingresses share
+    (``ServingServer.submit`` and ``FleetRouter.submit``), so router
+    and replica classification can never silently diverge — and each
+    request is tracked exactly once, at the ingress that owns it (a
+    replica behind a router has its tracking disabled).
+
+    `counter` takes the ingress's construction-time
+    ``serve/requests_total`` parent (the cached-sibling idiom of every
+    other hot-path counter here), skipping the per-submit registry-lock
+    name lookup; None resolves it per call."""
+    tenant = tenant or "default"
+    c = counter if counter is not None \
+        else registry.counter("serve/requests_total")
+    c.labels(tenant=tenant, tier=tier).inc()
+    eng = registry.slo
+    if eng is not None:
+        t0 = clock()
+        fut.add_done_callback(lambda f: eng.record(
+            tenant, tier, clock() - t0, error=f.error is not None))
+
+
+def track_rejection(registry: "obs.Registry", tenant: str,
+                    tier: str) -> None:
+    """Ingress-side SLO accounting for ONE caller-visible REJECTED
+    submit (tenant throttle, open admission breaker, full queue): a
+    shed request is a bad event under every objective, or total
+    admission failure — the exact outage the burn-rate engine pages on
+    — would read as a healthy SLO because only admitted futures ever
+    reach ``track_request``'s done-callback.  Cold path (rejections);
+    no-op without an installed engine."""
+    eng = registry.slo
+    if eng is not None:
+        eng.record(tenant or "default", tier, 0.0, error=True)
+
+
 class ServeFuture:
     """A per-request completion handle that resolves EXACTLY ONCE.
 
@@ -290,7 +334,10 @@ class RequestQueue:
         if self._closed:
             raise ServeClosedError("serving queue is closed")
         if not block and not self._breaker.allow():
-            self._c_shed.inc()
+            # labeled child rolls up into the unlabeled total, so the
+            # per-tenant split (ISSUE 15 cost accounting) is free and
+            # the aggregate dashboards keep their historical meaning
+            self._c_shed.labels(tenant=req.tenant or "default").inc()
             obs.spans.request_event(self._reg, "shed", req.trace, req.uuid,
                                     cause="breaker_open")
             raise ServeOverloadError(
@@ -307,7 +354,7 @@ class RequestQueue:
         if not self._put(req, block, timeout):
             if not block:
                 self._breaker.record_failure()
-            self._c_shed.inc()
+            self._c_shed.labels(tenant=req.tenant or "default").inc()
             obs.spans.request_event(self._reg, "shed", req.trace, req.uuid,
                                     cause="queue_full")
             raise ServeOverloadError(

@@ -85,6 +85,7 @@ from typing import Any, List, Optional, Sequence
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.obs import flightrec
 from textsummarization_on_flink_tpu.obs import http as obs_http
+from textsummarization_on_flink_tpu.obs import slo as slo_lib
 from textsummarization_on_flink_tpu.config import (
     SERVE_TIERS,
     HParams,
@@ -119,6 +120,8 @@ from textsummarization_on_flink_tpu.serve.queue import (
     RequestQueue,
     ServeFuture,
     ServeRequest,
+    track_rejection,
+    track_request,
 )
 
 log = logging.getLogger(__name__)
@@ -148,6 +151,7 @@ class ServingServer:
                  clock: Any = time.monotonic):
         self._hps = hps
         self._vocab = vocab
+        self._clock = clock
         self._reg = registry if registry is not None else obs.registry_for(hps)
         if decoder is None:
             # deferred: decoder pulls in beam_search -> jax; a server
@@ -255,6 +259,22 @@ class ServingServer:
             "beam": self._reg.counter("serve/tier_degraded_beam_total"),
             "spec": self._reg.counter("serve/tier_degraded_spec_total"),
         }
+        # per-tenant cost accounting: decoded tokens charged to the
+        # tenant that asked for them (the front door's savings
+        # counterpart lives in serve/frontdoor.py)
+        self._c_tenant_tokens = self._reg.counter(
+            "serve/tenant_tokens_total")
+        # the SLO burn-rate engine (obs/slo.py; SLO_POLICY.json at the
+        # repo root): first install on this registry wins, the clock is
+        # THIS server's (virtual in the committed gate) — request
+        # resolutions feed it via queue.track_request, dispatch rounds
+        # evaluate it.  _ingress_track gates the whole feed: a replica
+        # BEHIND a FleetRouter must not double-count what the router
+        # already tracks (the router-level future is the caller-visible
+        # request; replica attempts are implementation detail)
+        self._ingress_track = True
+        self._c_requests = self._reg.counter("serve/requests_total")
+        slo_lib.install_slo_engine(self._reg, clock=clock)
 
     # -- lifecycle --
     def start(self) -> "ServingServer":
@@ -462,6 +482,25 @@ class ServingServer:
     def __exit__(self, exc_type, exc, tb) -> None:
         self.stop()
 
+    def _track_request(self, fut: "ServeFuture", tenant: str,
+                       tier: str) -> "ServeFuture":
+        """Ingress accounting for one admitted future — the shared
+        ``queue.track_request`` helper (labeled requests_total + SLO
+        feed), gated off entirely behind a FleetRouter."""
+        if self._ingress_track:
+            track_request(self._reg, self._clock, fut, tenant, tier,
+                          counter=self._c_requests)
+        return fut
+
+    def disable_ingress_tracking(self) -> None:
+        """Stop counting this server's submits as caller-visible
+        requests (FleetRouter construction, alongside
+        ``disable_front_door``): the router tracks the one
+        caller-visible future per request — a replica also counting
+        each routed/hedged/requeued attempt would double-count
+        ``serve/requests_total`` and the SLO burn windows."""
+        self._ingress_track = False
+
     # -- request API --
     def submit(self, article: str, uuid: str = "", reference: str = "",
                block: bool = False, timeout: Optional[float] = None,
@@ -526,46 +565,58 @@ class ServingServer:
                 f"('map'/'fresh') or construct the decoder with "
                 f"draft_params=")
         flight = None
-        if self._door.armed:
-            # a stopped/killed server refuses new submits — checked
-            # BEFORE the door, or a cached article would keep
-            # "succeeding" against a dead server while uncached ones
-            # raise typed (the shutdown contract must not depend on
-            # what happens to be cached)
-            if self._queue.closed:
-                raise ServeClosedError("serving queue is closed")
-            # tenant bucket FIRST (a throttled tenant must not probe
-            # the cache), then cache/coalescing — both before the
-            # queue, so a hit or a follower never spends queue depth
-            self._door.admit_tenant(tenant, uuid)
-            kind, val = self._door.open(article, tier, uuid, reference,
-                                        trace=trace)
-            if kind in ("hit", "follower"):
-                return val
-            if kind == "leader":
-                flight = val
         try:
-            example = SummaryExample.build(
-                article, [], self._vocab, self._hps,
-                uuid=uuid, reference=reference)
-            req = ServeRequest(
-                uuid, article, reference, example,
-                deadline=Deadline.after(
-                    getattr(self._hps, "decode_deadline_secs", 0.0)),
-                registry=self._reg, tier=tier, trace=trace, tenant=tenant)
-            self._queue.submit(req, block=block, timeout=timeout)
-        except BaseException as e:
-            if flight is not None:
-                # the leader died before admission completed —
-                # tokenization error, queue full, closed: any follower
-                # that attached in the window fails with the same typed
-                # cause (it asked for exactly this computation), and
-                # the flight is retired so later duplicates lead fresh
-                self._door.abort(flight, e)
+            if self._door.armed:
+                # a stopped/killed server refuses new submits — checked
+                # BEFORE the door, or a cached article would keep
+                # "succeeding" against a dead server while uncached ones
+                # raise typed (the shutdown contract must not depend on
+                # what happens to be cached)
+                if self._queue.closed:
+                    raise ServeClosedError("serving queue is closed")
+                # tenant bucket FIRST (a throttled tenant must not probe
+                # the cache), then cache/coalescing — both before the
+                # queue, so a hit or a follower never spends queue depth
+                self._door.admit_tenant(tenant, uuid)
+                kind, val = self._door.open(article, tier, uuid, reference,
+                                            trace=trace, tenant=tenant)
+                if kind in ("hit", "follower"):
+                    return self._track_request(val, tenant, tier)
+                if kind == "leader":
+                    flight = val
+            try:
+                example = SummaryExample.build(
+                    article, [], self._vocab, self._hps,
+                    uuid=uuid, reference=reference)
+                req = ServeRequest(
+                    uuid, article, reference, example,
+                    deadline=Deadline.after(
+                        getattr(self._hps, "decode_deadline_secs", 0.0)),
+                    registry=self._reg, tier=tier, trace=trace,
+                    tenant=tenant)
+                self._queue.submit(req, block=block, timeout=timeout)
+            except BaseException as e:
+                if flight is not None:
+                    # the leader died before admission completed —
+                    # tokenization error, queue full, closed: any
+                    # follower that attached in the window fails with
+                    # the same typed cause (it asked for exactly this
+                    # computation), and the flight is retired so later
+                    # duplicates lead fresh
+                    self._door.abort(flight, e)
+                raise
+        except ServeOverloadError:
+            # a caller-visible shed (tenant throttle, open breaker,
+            # full queue) is a BAD event for the SLO burn windows:
+            # without this, total admission failure — the exact outage
+            # the engine pages on — reads as a healthy SLO because only
+            # admitted futures reach track_request's done-callback
+            if self._ingress_track:
+                track_rejection(self._reg, tenant, tier)
             raise
         if flight is not None:
             self._door.commit(flight, req.future)
-        return req.future
+        return self._track_request(req.future, tenant, tier)
 
     def pending(self) -> int:
         return self._queue.qsize()
@@ -670,6 +721,11 @@ class ServingServer:
                 # dispatch in-flight window (opened inside next_group)
                 # closes — idle()/load() stop counting it
                 self._batcher.end_group()
+            # burn-rate refresh once per dispatch round: the group's
+            # resolutions just landed in the SLO windows, so alert
+            # transitions (and the slo_burn flight dump) fire on the
+            # dispatch thread, deterministically per round
+            slo_lib.evaluate(self._reg)
             if self._stop.is_set() and self._queue.empty():
                 return
             try:
@@ -706,6 +762,9 @@ class ServingServer:
             n = self._cont.fail_resident(e)
             log.exception("continuous dispatch tick failed; rejected "
                           "%d resident request(s)", n)
+        # burn-rate refresh once per scheduler round (same rationale as
+        # the micro-batch loop's per-dispatch evaluate)
+        slo_lib.evaluate(self._reg)
         try:
             # same hot-swap cadence as the micro-batch loop (the
             # decoder self-gates at 60s); a resident article picks
@@ -791,7 +850,9 @@ class ServingServer:
         by_tier: dict = {}
         for r in group:
             queue_s = now - r.enqueue_t
-            self._h_queue_time.observe(queue_s)
+            self._h_queue_time.observe(
+                queue_s,
+                trace_id=r.trace.trace_id if r.trace is not None else None)
             if r.deadline.expired():
                 # the ISSUE-6 bugfix, micro-batch side: a request whose
                 # budget died in the queue is resolved typed instead of
@@ -878,7 +939,15 @@ class ServingServer:
                     self._c_tier_degraded[asked].inc()
             if tier in self._c_tier_done:
                 self._c_tier_done[tier].inc()
-            self._h_e2e.observe(done_t - r.enqueue_t)
+            # the landing bucket's exemplar is THIS request's trace_id
+            # (ISSUE 15): a fat p99 bucket on /metrics names a concrete
+            # uuid to chase through trace_summary.py --request
+            self._h_e2e.observe(
+                done_t - r.enqueue_t,
+                trace_id=r.trace.trace_id if r.trace is not None else None)
+            self._c_tenant_tokens.labels(
+                tenant=r.tenant or "default").inc(
+                len(getattr(res, "decoded_words", ()) or ()))
             self._c_done.inc()
             obs.spans.request_event(
                 self._reg, "finish", r.trace, r.uuid,

@@ -113,6 +113,15 @@ DEFAULT: Dict[str, Any] = {
                 r"^SummaryCache\.(get|put)$",
                 r"^RequestQueue\.(_put|_pop|_pick_tenant|get"
                 r"|get_nowait)$",
+                # the fleet telemetry plane (ISSUE 15): the SLO window
+                # evaluator runs once per dispatch/router round and its
+                # record side inside every future's resolve fan-out;
+                # the fleet merge loop runs on every /fleet/* scrape —
+                # a stray device sync in either stalls every replica's
+                # dispatch (or every scrape) at once
+                r"^SloEngine\.(record|evaluate)$",
+                r"^merge_fleet_series$",
+                r"^Registry\.series$",
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
