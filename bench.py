@@ -1569,6 +1569,14 @@ def bench_serve() -> None:
             # measurement basis
             refills0 = reg.counter("serve/slot_refills_total").value
             prefill0 = reg.counter("serve/prefill_total").value
+            # profiler phase snapshot (obs/profile.py, ISSUE 16): the
+            # timed window's per-phase means ride the row as evidence
+            # fields — fingerprint-neutral, like the trace split below
+            from textsummarization_on_flink_tpu.obs import (
+                profile as profile_lib,
+            )
+
+            phases0 = profile_lib.profiler_for(reg).phase_stats()
             evict0 = reg.counter("serve/deadline_evictions_total").value
             shed0 = reg.counter("serve/shed_total").value
             degraded0 = reg.counter("serve/degraded_total").value
@@ -1639,6 +1647,18 @@ def bench_serve() -> None:
             xs = sorted(xs)
             return xs[min(len(xs) - 1, int(len(xs) * q))]
 
+        # profiler-derived phase means over the timed window: the
+        # continuous path's serve/prefill + serve/dispatch (one sample
+        # per decode chunk), the micro-batch path's per-tier
+        # serve/dispatch (prefill stays 0 there — no prefill stage)
+        phases1 = profile_lib.profiler_for(reg).phase_stats()
+
+        def phase_ms_mean(name: str) -> float:
+            c1, s1, _ = phases1.get(name, (0, 0.0, 0.0))
+            c0, s0, _ = phases0.get(name, (0, 0.0, 0.0))
+            n = c1 - c0
+            return round(1e3 * (s1 - s0) / n, 3) if n else 0.0
+
         # per-uuid first-occurrence timestamps of each lifecycle stage
         per_req: dict = {}
         for ev in trace_sink.records():
@@ -1693,6 +1713,10 @@ def bench_serve() -> None:
                                       2) if resident_ms else 0.0,
             "resident_ms_p99": round(pct(resident_ms, 0.99), 2)
             if resident_ms else 0.0,
+            # profiler phase means (ISSUE 16; evidence only): encoder
+            # prefill per request vs decode wall per dispatch/chunk
+            "prefill_ms_mean": phase_ms_mean("serve/prefill"),
+            "decode_ms_mean": phase_ms_mean("serve/dispatch"),
             "traced_requests": len(queue_ms),
             "reqs": reqs,
             "concurrency": conc,

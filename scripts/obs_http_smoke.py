@@ -154,7 +154,11 @@ def main() -> None:
                   serve_refill_chunk=2, serve_max_queue=32)
     params = trainer.init_train_state(hps, vocab.size(), seed=0).params
 
-    events_dir = tempfile.mkdtemp(prefix="obs_http_smoke_")
+    # TS_SMOKE_OUT (ISSUE 16): a caller-named events dir, so repro.sh
+    # can hand the run's events.jsonl straight to perf_report.py
+    events_dir = os.environ.get("TS_SMOKE_OUT") or tempfile.mkdtemp(
+        prefix="obs_http_smoke_")
+    os.makedirs(events_dir, exist_ok=True)
     sink = obs.install_event_sink(events_dir, flush_secs=0.1)
     srv = obs.serve_http(0)  # ephemeral localhost port
     try:
@@ -174,6 +178,36 @@ def main() -> None:
             assert "serve/dispatch" in payload["components"], payload
             for f in futs:
                 f.result(timeout=600)
+            # performance attribution plane (ISSUE 16): /profile must
+            # answer on the live server with a non-empty phase table
+            # and the committed compile warm set — 4 decode kernels
+            # (init/pack/step/unpack) + one prefill per bucket USED
+            status, prof_body = get(srv.port, "/profile")
+            assert status == 200
+            prof = json.loads(prof_body)
+            assert prof["installed"], prof
+            phase_names = {p["phase"] for p in prof["phases"]}
+            assert {"serve/prefill", "serve/dispatch",
+                    "serve/harvest"} <= phase_names, phase_names
+            ledger = prof["compile_ledger"]
+            sites = ledger["sites"]
+            prefills = sites.get("decode/prefill_jit",
+                                 {"compiles": 0})["compiles"]
+            assert prefills >= 1, sites
+            decode_kernels = sum(
+                sites.get(k, {"compiles": 0})["compiles"]
+                for k in ("decode/init_slots_jit", "decode/pack_slot_jit",
+                          "decode/step_slots_jit",
+                          "decode/unpack_slot_jit"))
+            assert decode_kernels == 4, sites
+            assert ledger["warm_set"] == 4 + prefills, ledger
+            assert ledger["storm"] is None, ledger
+            # the profiler's cached storm/divergence state rides the
+            # /alerts scrape under the "profile" key
+            _, alerts_body = get(srv.port, "/alerts")
+            alerts = json.loads(alerts_body)
+            assert alerts["profile"]["installed"], alerts
+            assert alerts["profile"]["compile_storm"] is None, alerts
         # quiesced: an OpenMetrics-negotiated scrape must be
         # byte-identical to the in-process exposition (same counter
         # set, same values, exemplar annotations included); a plain
@@ -212,7 +246,10 @@ def main() -> None:
     print(f"obs http smoke OK: scrape == render_text "
           f"({len(body)} bytes), healthz {payload['status']} "
           f"({', '.join(sorted(payload['components']))}), uuid-3 timeline "
-          f"{sorted(stages)} over {tl['phases']['total_ms']:.1f} ms")
+          f"{sorted(stages)} over {tl['phases']['total_ms']:.1f} ms, "
+          f"/profile warm set {ledger['warm_set']} "
+          f"(4 decode + {prefills} prefill), coverage "
+          f"{prof['coverage']:.3f}")
 
     run_fleet_leg(hps, vocab, params)
 

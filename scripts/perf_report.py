@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Performance attribution report (ISSUE 16; OBSERVABILITY.md
+"Performance attribution").
+
+Two sources, one table:
+
+  * ``events.jsonl`` (obs/export.py EventSink): ``{"kind": "span"}``
+    records aggregated per span name — count, total/mean/max wall —
+    the offline view of where a run's time went;
+  * ``--url http://127.0.0.1:<port>/profile``: the live profiler
+    payload (obs/profile.py) — phase ledger, wall/coverage accounting,
+    compile ledger (warm set, per-site budgets, storm state),
+    divergence table, and the top-k slowest dispatches with trace
+    exemplar ids that paste straight into
+    ``scripts/trace_summary.py --request``.
+
+    python scripts/perf_report.py logs/exp/serve
+    python scripts/perf_report.py logs/exp/serve --json
+    python scripts/perf_report.py --url http://127.0.0.1:9100/profile
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import urllib.request
+from collections import defaultdict
+
+
+def find_event_files(root: str) -> list:
+    if os.path.isfile(root):
+        return [root]
+    return sorted(glob.glob(os.path.join(root, "**", "events.jsonl"),
+                            recursive=True))
+
+
+def span_table(paths: list) -> list:
+    """Aggregate span records per name: [{name, count, total_ms,
+    mean_ms, max_ms}], sorted by total descending."""
+    agg: dict = defaultdict(lambda: [0, 0.0, 0.0])  # count, total, max
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # half-written tail line of a live run
+                if rec.get("kind") != "span":
+                    continue
+                ms = float(rec.get("dur_us", 0)) / 1e3
+                row = agg[rec.get("name", "?")]
+                row[0] += 1
+                row[1] += ms
+                if ms > row[2]:
+                    row[2] = ms
+    return [{"name": name, "count": c,
+             "total_ms": round(total, 3),
+             "mean_ms": round(total / c, 3) if c else 0.0,
+             "max_ms": round(mx, 3)}
+            for name, (c, total, mx) in
+            sorted(agg.items(), key=lambda kv: -kv[1][1])]
+
+
+def fetch_profile(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def render_spans(rows: list, top: int) -> None:
+    print(f"{'span':<40} {'count':>7} {'total_ms':>12} "
+          f"{'mean_ms':>10} {'max_ms':>10}")
+    for row in rows[:top]:
+        print(f"{row['name']:<40} {row['count']:>7} "
+              f"{row['total_ms']:>12.3f} {row['mean_ms']:>10.3f} "
+              f"{row['max_ms']:>10.3f}")
+
+
+def render_profile(payload: dict, top: int) -> None:
+    if not payload.get("installed"):
+        print("profiler not installed on the scraped registry")
+        return
+    print(f"phase coverage: {payload.get('coverage', 0.0):.3f} "
+          f"(sum of phases / sum of walls)")
+    print(f"\n{'phase':<28} {'count':>7} {'total_s':>10} {'mean_ms':>10}")
+    for row in payload.get("phases", []):
+        print(f"{row['phase']:<28} {row['count']:>7} "
+              f"{row['total_s']:>10.4f} {row['mean_ms']:>10.3f}")
+    ledger = payload.get("compile_ledger", {})
+    print(f"\ncompile ledger: warm set {ledger.get('warm_set', 0)}"
+          + (", STORM: " + json.dumps(ledger["storm"])
+             if ledger.get("storm") else ""))
+    for site, st in sorted(ledger.get("sites", {}).items()):
+        budget = st.get("budget")
+        print(f"  {site:<28} compiles {st['compiles']:>3} "
+              f"hits {st['hits']:>6} budget "
+              f"{budget if budget is not None else '-':>3} "
+              f"keys {st['keys']}")
+    div = payload.get("divergence", [])
+    if div:
+        print("\ndivergence sentinel:")
+        for row in div:
+            print(f"  {row['site']}[{row['key']}] drift {row['drift']} "
+                  f"achieved {row['achieved_bytes_per_s']:.3g} B/s "
+                  f"baseline {row['baseline_bytes_per_s']:.3g} B/s")
+    slowest = payload.get("slowest", [])[:top]
+    if slowest:
+        print("\nslowest dispatches (trace ids feed "
+              "trace_summary.py --request):")
+        for row in slowest:
+            print(f"  {row['phase']:<28} {1e3 * row['dur_s']:>10.3f} ms "
+                  f"trace {row.get('trace_id') or '-'}")
+    for note in payload.get("notes", []):
+        print(f"note: {json.dumps(note)}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", nargs="?", default=None,
+                    help="events.jsonl file or directory holding one")
+    ap.add_argument("--url", default=None,
+                    help="live /profile endpoint to fetch")
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    if args.root is None and args.url is None:
+        ap.error("give an events.jsonl root and/or --url")
+    out: dict = {}
+    if args.root is not None:
+        paths = find_event_files(args.root)
+        if not paths:
+            print(f"no events.jsonl under {args.root}", file=sys.stderr)
+            return 2
+        out["spans"] = span_table(paths)
+        out["files"] = paths
+    if args.url is not None:
+        out["profile"] = fetch_profile(args.url)
+    if args.as_json:
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0
+    if "spans" in out:
+        print(f"span self-time over {len(out['files'])} events.jsonl "
+              f"file(s):")
+        render_spans(out["spans"], args.top)
+    if "profile" in out:
+        if "spans" in out:
+            print()
+        render_profile(out["profile"], args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,15 +102,30 @@ echo "== distill-spec smoke (narrow draft distilled -> adaptive spec decode)"
 # BYTE_BUDGET.json's spec section, enforced in the suite above)
 python scripts/spec_smoke.py --distill
 
-echo "== live-plane smoke (/metrics + /healthz scrape over a continuous run)"
+echo "== live-plane smoke (/metrics + /healthz + /profile over a continuous run)"
 # the ISSUE-9 exposition plane end to end: scrape-vs-render_text byte
-# parity, healthz component heartbeats, and one uuid's trace timeline
-# reconstructed from the unified events.jsonl (trace_summary --request)
-python scripts/obs_http_smoke.py
-
-echo "== bench smokes (CPU, tiny): train / input / decode / serve"
+# parity, healthz component heartbeats, one uuid's trace timeline
+# reconstructed from the unified events.jsonl (trace_summary --request),
+# and (ISSUE 16) the /profile phase table + compile-ledger warm set
+# scraped off the live run.  TS_SMOKE_OUT keeps the events.jsonl for
+# the perf-report stage below.
 T="$(mktemp -d)"
 trap 'rm -rf "$T"' EXIT
+TS_SMOKE_OUT="$T/smoke_events" python scripts/obs_http_smoke.py
+
+echo "== perf-report smoke (span self-time table off the smoke's events)"
+# the ISSUE-16 offline attribution view: the same events.jsonl the
+# trace timeline came from, aggregated per span name; the serve
+# dispatch/prefill spans the run just produced must show up
+python scripts/perf_report.py "$T/smoke_events" --json | python -c "
+import json, sys
+rep = json.load(sys.stdin)
+rows = rep['spans']
+names = {row['name'] for row in rows}
+assert {'serve/dispatch', 'serve/prefill'} <= names, names
+print(f'perf report OK: {len(rows)} span rows ({sorted(names)})')"
+
+echo "== bench smokes (CPU, tiny): train / input / decode / serve"
 for mode in train input decode serve; do
   BENCH_MODE="$mode" BENCH_PLATFORM=cpu BENCH_PRESET=tiny BENCH_STEPS=2 \
     BENCH_SECONDS=0.5 BENCH_SERVE_REQS=8 BENCH_SERVE_CONCURRENCY=4 \

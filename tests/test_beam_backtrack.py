@@ -21,10 +21,13 @@ import pytest
 
 from test_beam_search import make_arrays
 
+from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.data.vocab import START_ID, STOP_ID, UNK_ID
 from textsummarization_on_flink_tpu.decode import beam_search
 from textsummarization_on_flink_tpu.models import get_family
+from textsummarization_on_flink_tpu.obs import Registry
+from textsummarization_on_flink_tpu.obs import profile as profile_lib
 
 PG_HPS = HParams(batch_size=2, hidden_dim=8, emb_dim=6, vocab_size=24,
                  max_enc_steps=12, max_dec_steps=8, beam_size=3,
@@ -366,7 +369,10 @@ def test_finalize_adds_at_most_one_compile_to_warm_set():
     """ISSUE 7 acceptance detail: the backtrack lives INSIDE
     unpack_slot_jit, so a fresh config still warms the slot engine with
     exactly four compiles (init/pack/step/unpack) — the finalize pass
-    adds at most one executable (unpack's own), not a fifth kernel."""
+    adds at most one executable (unpack's own), not a fifth kernel.
+    Asserted through the shared compile ledger (obs/profile.py, ISSUE
+    16): every kernel call routes through compiled_call, whose
+    jit-cache diff IS the growth this test used to read by hand."""
     # a config no other test compiles, so cache deltas are attributable
     hps = PG_HPS.replace(max_oov_buckets=6, beam_size=2)
     family = get_family("pointer_generator")
@@ -375,20 +381,29 @@ def test_finalize_adds_at_most_one_compile_to_warm_set():
     slots = 2
     zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
             for k, v in arrays.items()}
-    kernels = (beam_search.init_slots_jit, beam_search.pack_slot_jit,
-               beam_search.step_slots_jit, beam_search.unpack_slot_jit)
-    before = {f: f._cache_size() for f in kernels}
-    state = beam_search.init_slots_jit(params, hps, zero)
-    one = {k: v[0:1] for k, v in arrays.items()}
-    state = beam_search.pack_slot_jit(
-        params, hps, state, 0, beam_search.prefill_jit(params, hps, one))
-    state, _ = beam_search.step_slots_jit(params, hps, state,
-                                          np.array([True, False]), 2)
-    beam_search.unpack_slot_jit(hps, state, 0)
-    growth = {f.__wrapped__.__name__: f._cache_size() - before[f]
-              for f in kernels}
-    assert growth == {"init_slots_jit": 1, "pack_slot_jit": 1,
-                      "step_slots_jit": 1, "unpack_slot_jit": 1}, growth
+    with obs.use_registry(Registry()) as reg:
+        def call(site, fn, *args):
+            return profile_lib.compiled_call(reg, site, fn, *args)
+
+        state = call("decode/init_slots_jit", beam_search.init_slots_jit,
+                     params, hps, zero)
+        one = {k: v[0:1] for k, v in arrays.items()}
+        pre = call("decode/prefill_jit", beam_search.prefill_jit,
+                   params, hps, one)
+        state = call("decode/pack_slot_jit", beam_search.pack_slot_jit,
+                     params, hps, state, 0, pre)
+        state, _ = call("decode/step_slots_jit",
+                        beam_search.step_slots_jit, params, hps, state,
+                        np.array([True, False]), 2)
+        call("decode/unpack_slot_jit", beam_search.unpack_slot_jit,
+             hps, state, 0)
+        stats = profile_lib.profiler_for(reg).compile_stats()
+    growth = {site: st["compiles"] for site, st in stats.items()
+              if site != "decode/prefill_jit"}
+    assert growth == {"decode/init_slots_jit": 1,
+                      "decode/pack_slot_jit": 1,
+                      "decode/step_slots_jit": 1,
+                      "decode/unpack_slot_jit": 1}, stats
 
 
 def test_warm_set_is_four_plus_one_prefill_per_bucket():
@@ -397,7 +412,10 @@ def test_warm_set_is_four_plus_one_prefill_per_bucket():
     index, occupancy, and valid length all traced) plus ONE prefill
     compile per bucket actually used — and after that warm set, no
     occupancy pattern, slot choice, article length, or length MIX
-    recompiles anything."""
+    recompiles anything.  Asserted through the shared compile ledger
+    (obs/profile.py, ISSUE 16): warm_set_size() is the 4 + one-per-
+    bucket committed number, the per-bucket prefill keys are named, and
+    the post-warm churn must land as ledger HITS, not compiles."""
     # a config no other test compiles, so cache deltas are attributable
     hps = PG_HPS.replace(max_oov_buckets=6, beam_size=2,
                          decode_enc_block=4, batch_size=3)
@@ -407,40 +425,63 @@ def test_warm_set_is_four_plus_one_prefill_per_bucket():
     slots = 3
     zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
             for k, v in arrays.items()}
-    kernels = (beam_search.init_slots_jit, beam_search.pack_slot_jit,
-               beam_search.step_slots_jit, beam_search.unpack_slot_jit,
-               beam_search.prefill_jit)
-    before = {f: f._cache_size() for f in kernels}
-
-    def pre_at(slot, bucket):
-        one = {k: (v[slot:slot + 1, :bucket] if v.ndim == 2
-                   else v[slot:slot + 1])
-               for k, v in arrays.items()}
-        return beam_search.prefill_jit(params, hps, one)
-
     buckets = (4, 8, 12)
-    state = beam_search.init_slots_jit(params, hps, zero)
-    for slot, bucket in enumerate(buckets):  # warm every bucket
-        state = beam_search.pack_slot_jit(params, hps, state, slot,
-                                          pre_at(slot, bucket))
-    state, _ = beam_search.step_slots_jit(
-        params, hps, state, np.array([True, True, True]), 2)
-    beam_search.unpack_slot_jit(hps, state, 1)
-    growth = {f.__wrapped__.__name__: f._cache_size() - before[f]
-              for f in kernels}
-    assert growth == {"init_slots_jit": 1, "pack_slot_jit": 1,
-                      "step_slots_jit": 1, "unpack_slot_jit": 1,
-                      "prefill_jit": len(buckets)}, growth
-    warm = {f: f._cache_size() for f in kernels}
-    # churn: different slots, buckets, occupancy patterns, length mixes
-    state = beam_search.pack_slot_jit(params, hps, state, 1,
-                                      pre_at(0, 4))
-    state, _ = beam_search.step_slots_jit(
-        params, hps, state, np.array([False, True, True]), 2)
-    state = beam_search.pack_slot_jit(params, hps, state, 0,
-                                      pre_at(2, 8))
-    state, _ = beam_search.step_slots_jit(
-        params, hps, state, np.array([True, False, False]), 2)
-    beam_search.unpack_slot_jit(hps, state, 0)
-    for f, n in warm.items():
-        assert f._cache_size() == n, f.__wrapped__.__name__
+    with obs.use_registry(Registry()) as reg:
+        prof = profile_lib.install_profiler(reg)
+        for kernel in ("decode/init_slots_jit", "decode/pack_slot_jit",
+                       "decode/step_slots_jit", "decode/unpack_slot_jit"):
+            prof.set_compile_budget(kernel, 1)
+        prof.set_compile_budget("decode/prefill_jit", len(buckets))
+
+        def call(site, fn, *args, key=""):
+            return profile_lib.compiled_call(reg, site, fn, *args, key=key)
+
+        def pre_at(slot, bucket):
+            one = {k: (v[slot:slot + 1, :bucket] if v.ndim == 2
+                       else v[slot:slot + 1])
+                   for k, v in arrays.items()}
+            return call("decode/prefill_jit", beam_search.prefill_jit,
+                        params, hps, one, key=bucket)
+
+        state = call("decode/init_slots_jit", beam_search.init_slots_jit,
+                     params, hps, zero)
+        for slot, bucket in enumerate(buckets):  # warm every bucket
+            state = call("decode/pack_slot_jit", beam_search.pack_slot_jit,
+                         params, hps, state, slot, pre_at(slot, bucket))
+        state, _ = call("decode/step_slots_jit",
+                        beam_search.step_slots_jit, params, hps, state,
+                        np.array([True, True, True]), 2)
+        call("decode/unpack_slot_jit", beam_search.unpack_slot_jit,
+             hps, state, 1)
+        stats = prof.compile_stats()
+        growth = {site: st["compiles"] for site, st in stats.items()}
+        assert growth == {"decode/init_slots_jit": 1,
+                          "decode/pack_slot_jit": 1,
+                          "decode/step_slots_jit": 1,
+                          "decode/unpack_slot_jit": 1,
+                          "decode/prefill_jit": len(buckets)}, stats
+        # the committed warm set: 4 decode kernels + one prefill/bucket
+        assert prof.warm_set_size() == 4 + len(buckets)
+        assert stats["decode/prefill_jit"]["keys"] == sorted(
+            str(b) for b in buckets), stats
+        # churn: different slots, buckets, occupancy patterns, length
+        # mixes — every call must land as a ledger HIT
+        state = call("decode/pack_slot_jit", beam_search.pack_slot_jit,
+                     params, hps, state, 1, pre_at(0, 4))
+        state, _ = call("decode/step_slots_jit",
+                        beam_search.step_slots_jit, params, hps, state,
+                        np.array([False, True, True]), 2)
+        state = call("decode/pack_slot_jit", beam_search.pack_slot_jit,
+                     params, hps, state, 0, pre_at(2, 8))
+        state, _ = call("decode/step_slots_jit",
+                        beam_search.step_slots_jit, params, hps, state,
+                        np.array([True, False, False]), 2)
+        call("decode/unpack_slot_jit", beam_search.unpack_slot_jit,
+             hps, state, 0)
+        after = prof.compile_stats()
+        assert prof.warm_set_size() == 4 + len(buckets), after
+        churn_hits = sum(st["hits"] for st in after.values()) \
+            - sum(st["hits"] for st in stats.values())
+        assert churn_hits == 7, after  # 2 prefills + 2 packs + 2 steps + 1 unpack
+        # within budget on every site => the storm trigger stayed silent
+        assert profile_lib.profile_alerts(reg)["compile_storm"] is None

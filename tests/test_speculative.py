@@ -23,6 +23,7 @@ from textsummarization_on_flink_tpu.decode import beam_search, speculative
 from textsummarization_on_flink_tpu.decode.decoder import BeamSearchDecoder
 from textsummarization_on_flink_tpu.models import avg_attention, get_family
 from textsummarization_on_flink_tpu.obs import Registry
+from textsummarization_on_flink_tpu.obs import profile as profile_lib
 from textsummarization_on_flink_tpu.serve.server import ServingServer
 
 TF_HPS = HParams(batch_size=3, hidden_dim=8, emb_dim=8, vocab_size=24,
@@ -166,14 +167,16 @@ def test_spec_acceptance_distribution_deterministic():
     np.testing.assert_array_equal(one.cycles, two.cycles)
 
 
-def test_spec_compiles_once_across_acceptance_patterns():
+def test_spec_compiles_once_across_acceptance_patterns(_isolated_obs):
     """Traced accept length (the step_slots_jit discipline): articles
     with different accept/reject patterns — including the adversarial
-    reject-everything draft — share ONE compiled program."""
+    reject-everything draft — share ONE compiled program.  Asserted
+    through the shared compile ledger (obs/profile.py, ISSUE 16): the
+    ledger's per-site miss/hit counts ARE the jit-cache diffs this test
+    used to read off run_spec_decode_jit._cache_size() by hand."""
     hps = TF_HPS
     params, draft = make_models(hps)
-    jax.clear_caches()
-    before = speculative.run_spec_decode_jit._cache_size()
+    jax.clear_caches()  # the ledger counts MISSES; start from cold
     for seed in range(4):
         speculative.run_spec_decode(params, draft, hps,
                                     make_arrays(hps, 3, seed=seed))
@@ -181,8 +184,13 @@ def test_spec_compiles_once_across_acceptance_patterns():
     bad_draft["out_bias"] = bad_draft["out_bias"].at[7].set(1e4)
     speculative.run_spec_decode(params, bad_draft, hps,
                                 make_arrays(hps, 3, seed=9))
-    assert speculative.run_spec_decode_jit._cache_size() == before + 1, (
-        "speculative decode recompiled across acceptance patterns")
+    prof = profile_lib.profiler_for(_isolated_obs)
+    site = prof.compile_stats()["decode/spec_decode_jit"]
+    assert site["compiles"] == 1, (
+        "speculative decode recompiled across acceptance patterns: "
+        f"{site}")
+    assert site["hits"] == 4, site
+    assert site["keys"] == [str(int(hps.spec_k))], site
 
 
 # -- acceptance-adaptive spec_k (ISSUE 12) ----------------------------------
@@ -290,17 +298,20 @@ class TestAdaptiveSpecK:
         # direction itself is pinned by test_spec_exact_under_reject_at_0)
         assert ctl.k == hps.spec_k_min, (ctl.k, ctl.alpha)
 
-    def test_warm_set_bounded_one_compile_per_distinct_k(self):
+    def test_warm_set_bounded_one_compile_per_distinct_k(
+            self, _isolated_obs):
         """The compile discipline: the cycle kernel compiles once per
         DISTINCT k the controller visits (carry shapes ride spec_k_max,
         so k changes never reshape), and repeats at a warm k add
-        nothing."""
+        nothing.  Asserted through the shared compile ledger
+        (obs/profile.py, ISSUE 16), whose per-k keys also pin WHICH k's
+        compiled — and whose committed budget (one kernel per k in
+        [k_min, k_max]) must not have fired a compile storm."""
         hps = TF_HPS.replace(spec_k_adaptive=True, spec_k=3,
                              spec_k_min=1, spec_k_max=5)
         hps.validate()
         params, draft = make_models(hps)
-        jax.clear_caches()
-        before = speculative.spec_cycle_jit._cache_size()
+        jax.clear_caches()  # the ledger counts MISSES; start from cold
         ks_seen = set()
 
         class Spy(speculative.SpecKController):
@@ -316,9 +327,16 @@ class TestAdaptiveSpecK:
             speculative.run_spec_decode(params, draft, hps,
                                         make_arrays(hps, 3, seed=seed),
                                         controller=ctl)
-        grown = speculative.spec_cycle_jit._cache_size() - before
-        assert grown == len(ks_seen), (grown, sorted(ks_seen))
-        assert grown <= hps.spec_k_max - hps.spec_k_min + 1
+        prof = profile_lib.profiler_for(_isolated_obs)
+        site = prof.compile_stats()["decode/spec_cycle_jit"]
+        budget = hps.spec_k_max - hps.spec_k_min + 1
+        assert site["compiles"] == len(ks_seen), (site, sorted(ks_seen))
+        assert site["keys"] == sorted(str(k) for k in ks_seen), site
+        assert site["compiles"] <= budget
+        assert site["budget"] == budget, site
+        # within budget => the storm trigger stayed silent
+        assert profile_lib.profile_alerts(
+            _isolated_obs)["compile_storm"] is None
 
     def test_decoder_accept_hist_buckets_span_k_max(self, _isolated_obs):
         """The ISSUE-12 satellite fix: the accept-length histogram's

@@ -153,6 +153,28 @@ class HParams:
     # (historical behavior), k>1 buffers k records per flush (the
     # reference flushes every 100 steps, run_summarization.py:242-244)
     summary_flush_every: int = 1
+    # ---- performance attribution plane (obs/profile.py; ISSUE 16) ----
+    # JAX/XLA profiler trace output dir for the trainer's steps-2..7
+    # capture window (train/trainer.py).  "" = no capture; the legacy
+    # TS_PROFILE_DIR env var is the fallback when unset, so existing
+    # launch scripts keep working.  Each capture lands in the profiler
+    # ledger as a `profiler_capture` note and a train/profiler_capture
+    # span.
+    profile_dir: str = ""
+    # Analytic pricing for the divergence sentinel: True registers
+    # __graft_entry__ cost-model providers (decode_step_cost /
+    # prefill_cost / train_step_cost) per dispatch shape, priced ONCE
+    # off the hot path, and publishes achieved bytes/s + FLOPs/s
+    # gauges against them.  Off by default: pricing AOT-compiles the
+    # costed program, which a short test job must not pay for.
+    profile_analytic: bool = False
+    # A dispatch counts as DIVERGED when its achieved bytes/s falls
+    # more than this factor below the shape's calibrated baseline
+    # (best of the first samples) — then the profiler dumps the flight
+    # ring (flight_perf_divergence.jsonl) and surfaces the entry on
+    # /alerts.  Must exceed 1; 5x tolerates normal jitter while still
+    # catching silent recompiles and host-sync regressions.
+    profile_divergence_factor: float = 5.0
     # ---- resilience (RESILIENCE.md; ISSUE 2) ----
     # fault-injection arming for THIS job: comma-separated
     # "point:prob:seed[:max]" specs (same syntax as the process-wide
@@ -601,6 +623,11 @@ class HParams:
         if self.summary_flush_every < 1:
             raise ValueError(f"summary_flush_every must be >= 1, got "
                              f"{self.summary_flush_every}")
+        if self.profile_divergence_factor <= 1.0:
+            raise ValueError(
+                f"profile_divergence_factor must be > 1 (a dispatch "
+                f"cannot 'diverge' by running at or above baseline), "
+                f"got {self.profile_divergence_factor}")
         if self.nan_skip_steps < 0 or self.nan_max_rollbacks < 0:
             raise ValueError("nan_skip_steps/nan_max_rollbacks must be >= 0")
         if not 0.0 < self.nan_lr_cut <= 1.0:

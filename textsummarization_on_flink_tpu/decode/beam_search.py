@@ -725,20 +725,16 @@ def run_beam_search(params, hps: HParams, arrays: Dict[str, np.ndarray],
     ids back to words (decode/decoder.py, mirroring decode.py:109-119).
     """
     loop = _loop_kind()
-    try:  # jit-cache growth across this call = a fresh trace/compile
-        before = run_beam_search_jit._cache_size()
-    except Exception:  # tslint: disable=TS005 — _cache_size is a private jax API; telemetry must never break decode
-        before = None
-    out = run_beam_search_jit(params, hps, arrays, loop=loop,
-                              chunk=resolved_chunk(loop))
-    if before is not None:
-        try:
-            from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu.obs import profile as profile_lib
 
-            missed = run_beam_search_jit._cache_size() > before
-            obs.registry_for(hps).counter(
-                "decode/compile_cache_misses_total" if missed
-                else "decode/compile_cache_hits_total").inc()
-        except Exception:  # tslint: disable=TS005 — best-effort cache-hit telemetry; decode result already in hand
-            pass
+    # the shared compile ledger (obs/profile.py, ISSUE 16) carries the
+    # jit-cache hit/miss telemetry this site used to hand-roll: cache
+    # growth across the call = a fresh trace/compile
+    chunk = resolved_chunk(loop)
+    out = profile_lib.compiled_call(
+        obs.registry_for(hps), "decode/beam_search_jit",
+        run_beam_search_jit, params, hps, arrays,
+        key=(loop, chunk), phase="decode/beam_search",
+        loop=loop, chunk=chunk)
     return BeamSearchOutput(*[np.asarray(x) for x in out])

@@ -33,6 +33,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.obs import profile as profile_lib
 from textsummarization_on_flink_tpu.checkpoint import checkpointer as ckpt_lib
 from textsummarization_on_flink_tpu.config import (
     SERVE_TIERS,
@@ -709,6 +710,26 @@ class SlotDecodeEngine:
         self._state = None  # lazy: first pack pays the init compile
         self._active = np.zeros(slots, dtype=bool)
         self._obs = obs.registry_for(self._hps)
+        # commit the compile-once warm set to the compile ledger
+        # (obs/profile.py, ISSUE 16): exactly one compile per decode
+        # kernel (idx/occupancy/valid-lengths all traced) and one
+        # prefill per serve bucket — growth beyond these budgets is a
+        # compile storm (flight dump + /alerts), not just a failed test
+        self._prof = profile_lib.profiler_for(self._obs)
+        for kernel in ("decode/init_slots_jit", "decode/pack_slot_jit",
+                       "decode/step_slots_jit", "decode/unpack_slot_jit"):
+            self._prof.set_compile_budget(kernel, 1)
+        self._prof.set_compile_budget("decode/prefill_jit",
+                                      len(self._buckets))
+        self._priced_buckets: set = set()
+        if getattr(self._hps, "profile_analytic", False):
+            # price the slot chunk ONCE for the divergence sentinel
+            # (the helper AOT-compiles; profile.py runs it off-thread)
+            chunk_hps, chunk = self._hps, self.chunk
+            self._prof.register_cost(
+                "serve/dispatch", f"slot_chunk{chunk}",
+                lambda: __import__("__graft_entry__").decode_step_cost(
+                    chunk_hps, path="slot", chunk=chunk))
         self._registry = None
         # (source params tree, its registry-placed copy): holding the
         # source object keeps its id live, so the identity check below
@@ -755,23 +776,14 @@ class SlotDecodeEngine:
         return jax.device_put(
             state, reg.shardings(reg.slot_state_specs(state)))
 
-    def _jitted(self, fn, *args, **kw):
-        """Run a slot kernel, mirroring run_beam_search's compile-cache
-        telemetry so 'no per-request recompiles' is observable."""
-        try:
-            before = fn._cache_size()
-        except Exception:  # tslint: disable=TS005 — _cache_size is a private jax API; telemetry must never break decode
-            before = None
-        out = fn(*args, **kw)
-        if before is not None:
-            try:
-                missed = fn._cache_size() > before
-                self._obs.counter(
-                    "decode/compile_cache_misses_total" if missed
-                    else "decode/compile_cache_hits_total").inc()
-            except Exception:  # tslint: disable=TS005 — best-effort cache telemetry; result already in hand
-                pass
-        return out
+    def _jitted(self, site, fn, *args, key="", **kw):
+        """Run a slot kernel through the shared compile ledger
+        (obs/profile.py, ISSUE 16): the jit-cache hit/miss telemetry
+        this method used to hand-roll, plus per-site compile events so
+        'no per-request recompiles' is runtime-monitored — growth past
+        the committed warm-set budget is a compile storm."""
+        return profile_lib.compiled_call(self._obs, site, fn, *args,
+                                         key=key, **kw)
 
     def _ensure_state(self, params) -> None:
         if self._state is not None:
@@ -792,8 +804,22 @@ class SlotDecodeEngine:
             zero = {k: jax.device_put(v, reg.named(specs[k]))
                     for k, v in zero.items()}
         self._state = self._pin_state(
-            self._jitted(beam_search.init_slots_jit, params,
+            self._jitted("decode/init_slots_jit",
+                         beam_search.init_slots_jit, params,
                          self._hps, zero))
+
+    def _register_prefill_cost(self, bucket: int) -> None:
+        """Queue analytic pricing of one prefill bucket for the
+        divergence sentinel (first use per bucket; gated on
+        hps.profile_analytic because prefill_cost AOT-compiles)."""
+        if not getattr(self._hps, "profile_analytic", False) \
+                or bucket in self._priced_buckets:
+            return
+        self._priced_buckets.add(bucket)
+        hps = self._hps
+        self._prof.register_cost(
+            "serve/prefill", bucket,
+            lambda: __import__("__graft_entry__").prefill_cost(hps, bucket))
 
     def prefill(self, example) -> PrefilledArticle:
         """The PREFILL stage for one SummaryExample (ISSUE 11): encoder
@@ -808,8 +834,9 @@ class SlotDecodeEngine:
                       enc_steps=bucket)
         arrays = {k: v for k, v in batch.as_arrays().items()
                   if k.startswith("enc_")}
-        pre = self._jitted(beam_search.prefill_jit, params, self._hps,
-                           arrays)
+        self._register_prefill_cost(bucket)
+        pre = self._jitted("decode/prefill_jit", beam_search.prefill_jit,
+                           params, self._hps, arrays, key=bucket)
         if self._registry is not None:
             import jax
 
@@ -828,7 +855,8 @@ class SlotDecodeEngine:
         params = self._params()
         self._ensure_state(params)
         self._state = self._pin_state(
-            self._jitted(beam_search.pack_slot_jit, params,
+            self._jitted("decode/pack_slot_jit",
+                         beam_search.pack_slot_jit, params,
                          self._hps, self._state, idx, item.state))
         self._active[idx] = True
 
@@ -845,8 +873,8 @@ class SlotDecodeEngine:
         with obs.spans.span(self._obs, "decode/slot_chunk",
                             active=int(self._active.sum())):
             self._state, finished = self._jitted(
-                beam_search.step_slots_jit, params, self._hps, self._state,
-                self._active, self.chunk)
+                "decode/step_slots_jit", beam_search.step_slots_jit,
+                params, self._hps, self._state, self._active, self.chunk)
             self._state = self._pin_state(self._state)
             # the one sanctioned chunk-boundary sync: the host scheduler
             # needs the finished mask to retire and refill slots
@@ -858,7 +886,8 @@ class SlotDecodeEngine:
         OOV map travel with the request, not the device state)."""
         if not self._active[idx]:
             raise AssertionError(f"slot {idx} is not resident")
-        out = self._jitted(beam_search.unpack_slot_jit, self._hps,
+        out = self._jitted("decode/unpack_slot_jit",
+                           beam_search.unpack_slot_jit, self._hps,
                            self._state, idx)
         self._active[idx] = False
         res = self._dec._make_result(

@@ -391,22 +391,16 @@ def run_spec_decode(full_params, draft_params, hps: HParams,
     fhps = hps.replace(beam_size=1)  # the verify path is single-hyp
     dhps = derive_draft_hps(hps).replace(beam_size=1, mode="decode")
     enc_arrays = {k: v for k, v in arrays.items() if k.startswith("enc_")}
-    try:  # mirror run_beam_search's compile-cache telemetry
-        before = run_spec_decode_jit._cache_size()
-    except Exception:  # tslint: disable=TS005 — private jax API; telemetry must never break decode
-        before = None
-    out = run_spec_decode_jit(full_params, draft_params, fhps, dhps,
-                              enc_arrays, int(hps.spec_k))
-    if before is not None:
-        try:
-            from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu.obs import profile as profile_lib
 
-            missed = run_spec_decode_jit._cache_size() > before
-            obs.registry_for(hps).counter(
-                "decode/compile_cache_misses_total" if missed
-                else "decode/compile_cache_hits_total").inc()
-        except Exception:  # tslint: disable=TS005 — best-effort cache-hit telemetry; decode result already in hand
-            pass
+    # run_beam_search's compile telemetry, via the one shared compile
+    # ledger (obs/profile.py, ISSUE 16) — one entry per distinct spec_k
+    out = profile_lib.compiled_call(
+        obs.registry_for(hps), "decode/spec_decode_jit",
+        run_spec_decode_jit, full_params, draft_params, fhps, dhps,
+        enc_arrays, int(hps.spec_k),
+        key=int(hps.spec_k), phase="decode/spec_cycle")
     return SpecDecodeOutput(*[np.asarray(x) for x in out])
 
 
@@ -588,8 +582,21 @@ def run_spec_decode_adaptive(full_params, draft_params, hps: HParams,
     dhps = derive_draft_hps(hps).replace(beam_size=1, mode="decode")
     k_cap = controller.k_max
     enc_arrays = {k: v for k, v in arrays.items() if k.startswith("enc_")}
-    f_enc, d_enc, carry = spec_prepare_jit(full_params, draft_params, fhps,
-                                           dhps, enc_arrays, k_cap)
+    from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu.obs import profile as profile_lib
+
+    reg = obs.registry_for(hps)
+    prof = profile_lib.profiler_for(reg)
+    # the committed warm set for the cycle kernel: one compile per
+    # distinct k the controller can pick (BYTE_BUDGET.json "adaptive";
+    # growth beyond it is a compile storm)
+    prof.set_compile_budget("decode/spec_cycle_jit",
+                            int(controller.k_max) - int(controller.k_min)
+                            + 1)
+    f_enc, d_enc, carry = profile_lib.compiled_call(
+        reg, "decode/spec_prepare_jit", spec_prepare_jit,
+        full_params, draft_params, fhps, dhps, enc_arrays, k_cap,
+        key=int(k_cap))
     enc_mask = jnp.asarray(enc_arrays["enc_padding_mask"])
     ext_ids = jnp.asarray(enc_arrays["enc_batch_extend_vocab"])
     real = (np.asarray(real_mask, dtype=bool) if real_mask is not None
@@ -600,9 +607,13 @@ def run_spec_decode_adaptive(full_params, draft_params, hps: HParams,
     k_cap = int(k_cap)
     for _ in range(fhps.max_dec_steps):
         k = controller.k  # host int by construction (SpecKController)
-        carry = spec_cycle_jit(full_params, draft_params, fhps, dhps,
-                               f_enc, d_enc, enc_mask, ext_ids, carry,
-                               k, k_cap)
+        # the ledger key is k itself (a host int by construction —
+        # SpecKController.k never holds a device value)
+        carry = profile_lib.compiled_call(
+            reg, "decode/spec_cycle_jit", spec_cycle_jit,
+            full_params, draft_params, fhps, dhps, f_enc, d_enc,
+            enc_mask, ext_ids, carry, k, k_cap,
+            key=k, phase="decode/spec_cycle")
         # the sanctioned between-cycle sync: ONE D2H fetch hands the
         # controller this cycle's accept histogram and the done flags
         # together (module docstring)

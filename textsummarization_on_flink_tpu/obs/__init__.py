@@ -69,7 +69,8 @@ __all__ = [
     "set_default_registry", "use_registry", "counter", "gauge", "histogram",
     "span", "request_event", "render_text", "snapshot", "snapshot_event",
     "install_event_sink", "write_chrome_trace", "tracer_for", "heartbeat",
-    "install_flight_recorder", "serve_http",
+    "install_flight_recorder", "serve_http", "install_profiler",
+    "profiler_for",
 ]
 
 _default: Optional[Registry] = None
@@ -178,6 +179,25 @@ def install_flight_recorder(directory: str, capacity: Optional[int] = None,
     kw = {"capacity": capacity} if capacity is not None else {}
     return flight_mod.install_flight_recorder(
         reg if reg is not None else registry(), directory, **kw)
+
+
+def install_profiler(reg: Optional[Registry] = None, **kw: Any):
+    """Attach the performance attribution plane (obs/profile.py, ISSUE
+    16) to `reg` (default registry when None): phase ledger + compile
+    ledger + divergence sentinel, exposed on /profile.  First install
+    wins; kwargs (clock, divergence_factor) thread to the Profiler."""
+    from textsummarization_on_flink_tpu.obs import profile as profile_mod
+
+    return profile_mod.install_profiler(
+        reg if reg is not None else registry(), **kw)
+
+
+def profiler_for(reg: Optional[Registry] = None):
+    """The registry's profiler (obs/profile.py), or the shared null
+    profiler for a dark registry — safe to call on every dispatch."""
+    from textsummarization_on_flink_tpu.obs import profile as profile_mod
+
+    return profile_mod.profiler_for(reg if reg is not None else registry())
 
 
 def serve_http(port: int, reg: Optional[Registry] = None):

@@ -53,6 +53,7 @@ import time
 import urllib.parse
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from textsummarization_on_flink_tpu.obs import profile as profile_lib
 from textsummarization_on_flink_tpu.obs import slo as slo_lib
 from textsummarization_on_flink_tpu.obs import spans as spans_lib
 from textsummarization_on_flink_tpu.obs.registry import (
@@ -310,7 +311,17 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     else []
                 self._send_json(200, [r.as_event() for r in recs[-n:]])
             elif route == "/alerts":
-                self._send_json(200, slo_lib.alerts_payload(reg))
+                payload = slo_lib.alerts_payload(reg)
+                # the profiler's cached storm/divergence state rides the
+                # same scrape (ISSUE 16) — read-only, like the SLO rows
+                payload["profile"] = profile_lib.profile_alerts(reg)
+                self._send_json(200, payload)
+            elif route == "/profile":
+                # performance attribution plane (obs/profile.py, ISSUE
+                # 16): phase table, compile ledger, divergence table,
+                # top-k slowest dispatches.  Served from state cached on
+                # the record side — a scrape never mutates the ledgers.
+                self._send_json(200, profile_lib.profile_payload(reg))
             elif route == "/exemplars":
                 self._send_json(200, exemplars(reg))
             elif route in ("/fleet/metrics", "/fleet/snapshot"):
@@ -330,7 +341,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send_json(404, {"error": f"no route {route!r}",
                                       "routes": ["/metrics", "/healthz",
                                                  "/snapshot", "/spans",
-                                                 "/alerts", "/exemplars",
+                                                 "/alerts", "/profile",
+                                                 "/exemplars",
                                                  "/fleet/metrics",
                                                  "/fleet/snapshot"]})
         except Exception:  # tslint: disable=TS005 — exposition must never kill the scrape thread; failures are counted and answered with a 500

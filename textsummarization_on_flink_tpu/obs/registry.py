@@ -539,6 +539,10 @@ class Registry:
         self.fleet_sources = None  # Optional[Callable[[], Dict[str, Registry]]]
         # the SLO burn-rate engine (obs/slo.py), when installed
         self.slo = None
+        # the performance attribution plane (obs/profile.py, ISSUE 16):
+        # phase ledger + compile ledger + divergence sentinel, attached
+        # first-install-wins by profile.install_profiler
+        self.profile = None
 
     def _note_label_evictions(self, n: int) -> None:
         self.counter("obs/label_evictions_total").inc(n)

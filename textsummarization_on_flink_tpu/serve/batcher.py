@@ -32,6 +32,7 @@ from typing import Any, Deque, List, Optional, Tuple
 
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.obs import flightrec
+from textsummarization_on_flink_tpu.obs import profile as profile_lib
 from textsummarization_on_flink_tpu.config import (
     HParams,
     parse_bucket_spec,
@@ -221,6 +222,15 @@ class ContinuousBatcher:
         self._tick_refills = 0
         reg = registry if registry is not None else obs.registry_for(hps)
         self._reg = reg
+        # the phase ledger (obs/profile.py, ISSUE 16): every tick's
+        # evict/prefill/pack/dispatch/harvest wall lands in labeled
+        # phase histograms, bracketed by a per-tick wall so the
+        # phases-sum-to-wall accounting check holds (dark registries
+        # get the allocation-free null profiler)
+        self._prof = profile_lib.profiler_for(reg)
+        # the divergence sentinel's dispatch-shape key: the slot chunk
+        # is the one compiled decode program this batcher drives
+        self._dispatch_key = f"slot_chunk{getattr(engine, 'chunk', 0)}"
         self._g_active = reg.gauge("serve/slots_active")
         # the /healthz-scrapeable routing input (ISSUE 13): the
         # FleetRouter's least-loaded pick wants free capacity, and
@@ -378,6 +388,7 @@ class ContinuousBatcher:
             may_block = False
             if req is None:
                 break
+            t0 = self._prof.start()
             try:
                 with obs.spans.span(self._reg, "serve/prefill"):
                     pre = self._engine.prefill(req.example)
@@ -389,7 +400,11 @@ class ContinuousBatcher:
                 self._c_errors.inc()
                 req.future._reject(e)
                 raise
+            trace_id = req.trace.trace_id if req.trace is not None else None
+            dt = self._prof.end("serve/prefill", t0, trace_id=trace_id)
             bucket = int(getattr(pre, "bucket", req.example.enc_len))
+            self._prof.observe_dispatch("serve/prefill", bucket, dt,
+                                        trace_id=trace_id)
             self._c_prefills.inc()
             self._h_prefill_bucket.observe(bucket)
             obs.spans.request_event(
@@ -431,6 +446,7 @@ class ContinuousBatcher:
                     if req is None:
                         return
                     payload = req.example
+                t0 = self._prof.start()
                 try:
                     self._engine.pack(idx, payload)
                 except Exception as e:
@@ -440,6 +456,9 @@ class ContinuousBatcher:
                     self._c_errors.inc()
                     req.future._reject(e)
                     raise
+                self._prof.end("serve/pack", t0,
+                               trace_id=req.trace.trace_id
+                               if req.trace is not None else None)
                 self._resident[idx] = req
                 self._chunks[idx] = 0
                 self._c_refills.inc()
@@ -499,7 +518,15 @@ class ContinuousBatcher:
         self._tick += 1
         self._tick_evictions = 0
         self._tick_refills = 0
+        # the per-tick wall bracket (obs/profile.py, ISSUE 16) closes
+        # only on busy ticks: an idle tick blocks up to `poll` seconds
+        # inside the queue poll, and that wait is idleness, not an
+        # attributable phase — counting it would sink the coverage
+        # ratio without naming a phase to fix
+        w0 = self._prof.start()
+        t0 = self._prof.start()
         self._evict_expired()
+        self._prof.end("serve/evict", t0)
         self._prefill_stage(poll)
         self._refill(poll)
         if not self.busy():
@@ -509,6 +536,7 @@ class ContinuousBatcher:
         # the dump holds everything strictly preceding the trigger
         n_active = sum(r is not None for r in self._resident)
         self._record_frame(n_active / self.slots)
+        t0 = self._prof.start()
         with obs.spans.span(
                 self._reg, "serve/dispatch",
                 fill=n_active, tick=self._tick):
@@ -516,11 +544,19 @@ class ContinuousBatcher:
                     "serve.dispatch"):
                 raise RuntimeError("injected serve.dispatch fault")
             finished = self._engine.step()
+        dt = self._prof.end("serve/dispatch", t0)
+        # divergence sentinel: the slot-chunk program is the one
+        # dispatch shape continuous mode executes — price once, then
+        # compare every chunk's achieved bytes/s against it
+        self._prof.observe_dispatch("serve/dispatch", self._dispatch_key, dt)
         self._h_occupancy.observe(n_active / self.slots)
         for idx, req in enumerate(self._resident):
             if req is not None:
                 self._chunks[idx] += 1
+        t0 = self._prof.start()
         self._harvest(finished)
+        self._prof.end("serve/harvest", t0)
+        self._prof.end_wall("serve/tick", w0)
         return True
 
     def fail_resident(self, error: BaseException) -> int:

@@ -85,6 +85,7 @@ from typing import Any, List, Optional, Sequence
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.obs import flightrec
 from textsummarization_on_flink_tpu.obs import http as obs_http
+from textsummarization_on_flink_tpu.obs import profile as profile_lib
 from textsummarization_on_flink_tpu.obs import slo as slo_lib
 from textsummarization_on_flink_tpu.config import (
     SERVE_TIERS,
@@ -153,6 +154,15 @@ class ServingServer:
         self._vocab = vocab
         self._clock = clock
         self._reg = registry if registry is not None else obs.registry_for(hps)
+        # the performance attribution plane (obs/profile.py, ISSUE 16):
+        # installed before the batcher/decoder wirings so every phase
+        # timer and compile-ledger site shares THIS server's clock
+        # (virtual in the deterministic gates); first install on the
+        # registry wins, like the SLO engine below
+        profile_lib.install_profiler(
+            self._reg, clock=clock,
+            divergence_factor=float(getattr(
+                hps, "profile_divergence_factor", 5.0)))
         if decoder is None:
             # deferred: decoder pulls in beam_search -> jax; a server
             # built around an injected stub must not pay that import
@@ -891,6 +901,12 @@ class ServingServer:
         flightrec.record(self._reg, "serve_dispatch", fill=len(group),
                          queue_depth=self._queue.qsize(),
                          tier=tier or "legacy")
+        # per-tier micro-batch dispatch phase (obs/profile.py, ISSUE
+        # 16): one labeled phase sample per device dispatch, keyed by
+        # the effective tier so the /profile phase table splits beam
+        # from greedy from spec wall time
+        prof = profile_lib.profiler_for(self._reg)
+        t0 = prof.start()
         try:
             with obs.spans.span(self._reg, "serve/dispatch",
                                 fill=len(group), tier=tier or "legacy"):
@@ -904,6 +920,9 @@ class ServingServer:
                 else:
                     results = self._decoder.decode_batch(
                         batch, deadline=deadline, tier=tier)
+            dt = prof.end("serve/dispatch", t0)
+            prof.observe_dispatch(
+                "serve/dispatch", f"tier_{tier or 'legacy'}", dt)
             if len(results) != len(group):
                 raise RuntimeError(
                     f"decoder returned {len(results)} results for "

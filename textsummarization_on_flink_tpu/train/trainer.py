@@ -31,6 +31,7 @@ import numpy as np
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.obs import flightrec
 from textsummarization_on_flink_tpu.obs import http as obs_http
+from textsummarization_on_flink_tpu.obs import profile as profile_lib
 from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.models import get_family
 from textsummarization_on_flink_tpu.resilience import faultinject
@@ -545,6 +546,19 @@ class Trainer:
         # the way one serve request's do
         self._trace = (obs.TraceContext.new() if self._obs.enabled
                        else None)
+        # the phase ledger (obs/profile.py, ISSUE 16): the loop's
+        # host-wait/step-dispatch/metrics-flush/checkpoint sub-phases
+        # bracketed by a per-round wall; dark jobs get the null
+        # profiler (constant-return, no per-step allocation)
+        self._prof = profile_lib.profiler_for(self._obs)
+        if getattr(hps, "profile_analytic", False):
+            # analytic train-step pricing for the divergence sentinel —
+            # AOT cost analysis runs off the hot path (provider thread)
+            cost_hps = hps
+            self._prof.register_cost(
+                "train/step_dispatch", "step",
+                lambda: __import__("__graft_entry__").train_step_cost(
+                    cost_hps))
         # failure flight recorder: per-step frames ring in memory and
         # dump to <train_dir>/flight_<reason>.jsonl when the NaN
         # watchdog / divergence recovery fires (OBSERVABILITY.md)
@@ -633,15 +647,21 @@ class Trainer:
         batcher is exhausted).
 
         Profiling (SURVEY §5.1): the reference logs per-step wall clock
-        only; here TS_PROFILE_DIR=<dir> additionally captures a JAX/XLA
-        profiler trace of steps 2-7 (post-compilation) for TensorBoard's
-        trace viewer.
+        only; here HParams(profile_dir=...) — or the legacy
+        TS_PROFILE_DIR env fallback — captures a JAX/XLA profiler trace
+        of steps 2-7 (post-compilation) for TensorBoard's trace viewer,
+        and the capture window lands in the profiler ledger as a
+        `profiler_capture` note (ISSUE 16) so /profile shows WHEN a
+        trace was taken alongside the phase table it annotates.
         """
         limit = self.hps.num_steps if num_steps is None else num_steps
         # checkpoint cadence is a DURATION: monotonic, never wall clock
         # (TS003 — an NTP slew/suspend must not skip or double a save)
         last_ckpt = time.monotonic()
-        profile_dir = os.environ.get("TS_PROFILE_DIR")
+        # HParam wins over the env fallback: a config-driven run must
+        # not be silently redirected by ambient shell state
+        profile_dir = (getattr(self.hps, "profile_dir", "")
+                       or os.environ.get("TS_PROFILE_DIR"))
         # anchor to the first step of THIS run (may resume past step 2)
         profile_start = int(self.state.step) + 2
         profile_stop = profile_start + 5
@@ -734,6 +754,7 @@ class Trainer:
         multi-step scan otherwise."""
         if not pending:
             return
+        p0 = self._prof.start()
         # the fetch is a blocking D2H sync — its cost is exactly the
         # dispatch-serialization price the windowing amortizes, so it is
         # measured (train/metrics_fetch_seconds) rather than guessed
@@ -789,6 +810,7 @@ class Trainer:
                         f"bad one; --debug pins the window to 1 for "
                         f"step-exact detection)")
                 self.writer.scalars(step + 1, **scalars)
+        self._prof.end("train/metrics_flush", p0)
 
     def _dump_nan_batch(self, step: int, arrays) -> None:
         """--debug: persist the batch that produced a non-finite loss
@@ -874,6 +896,10 @@ class Trainer:
                                period=obs_http.LOOP_HEARTBEAT_PERIOD)
             if limit and step >= limit:
                 break
+            # per-round wall bracket (obs/profile.py, ISSUE 16): the
+            # sub-phases below sum toward it, and the gap is the loop's
+            # unattributed overhead (stacking, bookkeeping)
+            w0 = self._prof.start()
             # k batches per dispatch (steps_per_dispatch), clipped to the
             # remaining step budget so the limit stays exact
             k = self.steps_per_dispatch
@@ -881,6 +907,7 @@ class Trainer:
                 k = min(k, limit - step)
             items = []
             t_wait = time.perf_counter()
+            p0 = self._prof.start()
             while len(items) < k:
                 item = prefetcher.next_batch()
                 if item is None:
@@ -890,6 +917,7 @@ class Trainer:
             # host-wait: time the loop spent blocked on the input side
             # while the device sat idle (dispatch itself is async)
             self._m_host_wait.observe(time.perf_counter() - t_wait)
+            self._prof.end("train/host_wait", p0)
             if exhausted and (multihost and (limit == 0 or step + len(items)
                                              < limit)):
                 raise RuntimeError(
@@ -909,8 +937,15 @@ class Trainer:
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
                 window_t0 = time.monotonic()
+                # the capture's opening edge in the profiler ledger
+                # (ISSUE 16): /profile names the step range a trace
+                # covers without grepping logs
+                self._prof.note("profiler_capture", dir=str(profile_dir),
+                                start_step=profile_start,
+                                stop_step=profile_stop)
                 log.info("profiler trace started -> %s", profile_dir)
             n = len(items)
+            p0 = self._prof.start()
             try:
                 if n == 1:
                     _, arrays = items[0]
@@ -947,6 +982,11 @@ class Trainer:
                 raise NonFiniteLossError(
                     f"Loss is not finite. Stopping. (step {step}; "
                     f"jax_debug_nans trace above)") from e
+            # dispatch-submit time (async under jax: device compute
+            # overlaps with the host loop; the blocking D2H fetches are
+            # the metrics-flush phase, not this one)
+            dt = self._prof.end("train/step_dispatch", p0)
+            self._prof.observe_dispatch("train/step_dispatch", "step", dt)
             injected = self._faults.fire("train.step_nan")
             if self._recovery is not None:
                 # armed: one D2H metrics sync per dispatch — poisoned
@@ -998,7 +1038,14 @@ class Trainer:
                 pending_steps = 0
                 window_t0 = time.monotonic()
             if profiling and step > profile_stop:
-                jax.profiler.stop_trace()
+                # the finalize edge gets its own span so one capture is
+                # one linkable event in events.jsonl (trace_summary.py
+                # lanes show the trace window next to the step spans)
+                with obs.spans.span(self._obs, "train/profiler_capture",
+                                    parent=self._trace,
+                                    start_step=profile_start,
+                                    stop_step=profile_stop):
+                    jax.profiler.stop_trace()
                 profiling = False
                 profile_done = True
                 log.info("profiler trace written to %s", profile_dir)
@@ -1017,9 +1064,12 @@ class Trainer:
                     self._flush_metrics(pending, time.monotonic() - window_t0)
                     pending = []
                     pending_steps = 0
+                    p0 = self._prof.start()
                     self.checkpointer.save(self.state)
+                    self._prof.end("train/checkpoint", p0)
                     last_ckpt = time.monotonic()
                     window_t0 = time.monotonic()
+            self._prof.end_wall("train/round", w0)
         self._flush_metrics(pending, time.monotonic() - window_t0)
         if profiling:
             jax.profiler.stop_trace()

@@ -122,6 +122,16 @@ DEFAULT: Dict[str, Any] = {
                 r"^SloEngine\.(record|evaluate)$",
                 r"^merge_fleet_series$",
                 r"^Registry\.series$",
+                # the performance attribution plane (ISSUE 16): phase
+                # timers close on every tick/dispatch, the compile
+                # ledger wraps every jitted decode call, and the
+                # divergence sentinel judges every priced dispatch — a
+                # stray sync in any record path becomes a per-chunk
+                # stall on the very path it is supposed to measure
+                r"^Profiler\.(start|end|end_wall)$",
+                r"^Profiler\.(record_compile|record_hit"
+                r"|observe_dispatch)$",
+                r"^compiled_call$",
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
