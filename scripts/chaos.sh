@@ -219,6 +219,17 @@ print(f"serve.replica_kill OK: 1 injected replica death, {requeued} "
 PY
 
 echo
+echo "== TS_FAULTS sweep: serve.proc_kill (OS-process fleet, SIGKILL failover)"
+# the ISSUE-17 process boundary end to end: 3 supervised child
+# processes behind the socket transport; the armed point makes the
+# supervision thread SIGKILL the most-loaded live pid mid-decode, and
+# the smoke asserts exactly-once + row parity + typed requeues on
+# survivors + the victim restarted and readmitted through the rotation
+# breaker's half-open probe (full contract in scripts/fleet_smoke.py)
+TS_FAULTS="serve.proc_kill:1.0:0:1" python scripts/fleet_smoke.py \
+  --transport=proc
+
+echo
 echo "== TS_FAULTS sweep: serve.cache_fault (front door degrades to miss)"
 TS_FAULTS="serve.cache_fault:1.0:0" python - <<'PY'
 from textsummarization_on_flink_tpu import obs

@@ -77,6 +77,18 @@ echo "== fleet smoke (3 replicas, kill one under load, exactly-once + parity)"
 # enforced in the suite above)
 python scripts/fleet_smoke.py
 
+echo "== process-fleet smoke (3 OS child processes, SIGKILL mid-decode)"
+# the ISSUE-17 process boundary end to end: the same router fronts 3
+# SUPERVISED child processes (cli.py serve-replica) over the socket
+# transport; one child is SIGKILLed on a real pid mid-decode, its
+# orphans requeue on survivors (exactly-once + row parity vs the solo
+# run), the victim restarts under supervision and is readmitted
+# through the rotation breaker's half-open probe, and the survivors'
+# events.jsonl ledgers witness every finish (the committed transport
+# overhead ceilings live in SERVE_SLO.json process_fleet, enforced in
+# the suite above; the armed serve.proc_kill sweep is in chaos.sh)
+python scripts/fleet_smoke.py --transport=proc
+
 echo "== front-door smoke (coalescing + summary cache on a real model)"
 # the ISSUE-14 front door end to end: a duplicate-heavy burst coalesces
 # onto shared decodes, the warm pass serves byte-identical rows from

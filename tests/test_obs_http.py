@@ -7,7 +7,9 @@ injectable monotonic clock, never with sleeps.
 """
 
 import json
+import os
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -236,6 +238,25 @@ class TestHealthz:
         assert "train/loop" in payload["components"]
         # disabled registry: no components, never degraded
         assert obs_http.health(Registry(enabled=False))["status"] == "ok"
+
+    def test_healthz_carries_incarnation_identity(self, served):
+        """The ISSUE-17 satellite: /healthz carries pid, process
+        start_time, and the stamped replica_id so a process supervisor
+        can verify WHICH incarnation answered — a stale portfile
+        pointing at a previous (or recycled) pid must not pass the
+        readiness handshake (procfleet.ReplicaProcess keys on exactly
+        these fields)."""
+        reg, srv = served
+        _, body = _get(srv.port, "/healthz")
+        payload = json.loads(body)
+        assert payload["pid"] == os.getpid()
+        assert payload["start_time"] == pytest.approx(
+            obs_http._PROCESS_START_TIME)
+        assert payload["start_time"] <= time.time()
+        assert payload["replica_id"] == ""  # unstamped registry
+        reg.replica_id = "p7"
+        _, body = _get(srv.port, "/healthz")
+        assert json.loads(body)["replica_id"] == "p7"
 
 
 class TestGating:

@@ -26,6 +26,22 @@ def test_message_missing_fields_default_empty():
     assert m.to_row() == ("x", "", "", "")
 
 
+def test_message_tier_error_ride_the_wire_only_when_set():
+    """ISSUE 17: the process-fleet transport extends the codec with
+    tier + error, but the classic 4-field wire must stay byte-stable —
+    a frame without them encodes exactly the pre-extension shape."""
+    classic = io_lib.Message("u1", "art", "sum", "ref")
+    assert set(json.loads(classic.to_json())) == {
+        "uuid", "article", "summary", "reference"}
+    m = io_lib.Message("u2", "art", "", "ref", tier="draft",
+                       error="ServeOverloadError: shed")
+    m2 = io_lib.Message.from_json(m.to_json())
+    assert (m2.tier, m2.error) == ("draft", "ServeOverloadError: shed")
+    # missing on the wire -> empty defaults, never a KeyError
+    legacy = io_lib.Message.from_json(json.dumps({"uuid": "x"}))
+    assert (legacy.tier, legacy.error) == ("", "")
+
+
 # -- schemas / type matrix (CodingUtils.java:25-129) --
 
 def test_schema_select_and_project():

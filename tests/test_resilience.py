@@ -329,7 +329,8 @@ class TestFaultSpecs:
         assert set(faultinject.KNOWN_POINTS) == {
             "io.connect", "io.read", "io.write",
             "ckpt.load", "train.step_nan", "etl.worker",
-            "serve.dispatch", "serve.replica_kill", "serve.cache_fault"}
+            "serve.dispatch", "serve.replica_kill", "serve.cache_fault",
+            "serve.proc_kill"}
 
 
 class TestFaultPlan:

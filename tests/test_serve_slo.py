@@ -1058,3 +1058,132 @@ def test_fleet_replica_kill_exactly_once_with_requeue(slo):
     # no admitted request saw the failure: all resolved with results
     assert len(results) == wl["requests"]
     assert len({r.uuid for r in results}) == wl["requests"]
+
+
+# ---------------------------------------------------------------------------
+# Process fleet (ISSUE 17; SERVING.md "Process fleet").  The socket
+# transport's costs are BYTE facts, not scheduling facts, so there is
+# no virtual clock: the gate prices them analytically off the REAL
+# codecs — Message.to_json() frames as the supervisor sends them, reply
+# frames out of the real _ReplyHub publish path (seq stamping
+# included), and the real obs.http.health() payload at the
+# serve_scrape_interval_ms cadence.  Pure construction + arithmetic;
+# see SERVE_SLO.json process_fleet._comment for the committed numbers.
+
+
+def _proc_fleet_requests(wl):
+    def words(n, tag):
+        return " ".join(f"{tag}{i}" for i in range(n)) + " ."
+
+    reqs = []
+    for i in range(wl["requests"]):
+        long = (i % wl["long_every"]) == wl["long_every"] - 1
+        art = words(wl["long_words"] if long else wl["short_words"], "w")
+        reqs.append((f"uuid-{i:04d}", art, f"reference {i} ."))
+    return reqs, words(wl["summary_words"], "s")
+
+
+@pytest.fixture(scope="module")
+def proc_fleet_measured(slo):
+    from textsummarization_on_flink_tpu.pipeline.io import Message
+    from textsummarization_on_flink_tpu.serve import procfleet
+
+    wl = slo["process_fleet"]["workload"]
+    reqs, summary = _proc_fleet_requests(wl)
+    # ingress: the exact frame RemoteReplica.submit writes (+ newline)
+    ingress = [len(Message(u, a, r).to_json().encode()) + 1
+               for u, a, r in reqs]
+    # reply: through the real hub so the seq envelope is priced too
+    hub = procfleet._ReplyHub()
+    for u, a, r in reqs:
+        hub.publish(Message(u, a, summary=summary, reference=r))
+    hub.close()
+    reply = [len(frame.encode()) + 1 for frame in hub.stream(0)]
+    assert len(reply) == len(ingress)
+    payload = [len(u) + len(a) + len(summary) + len(r) for u, a, r in reqs]
+    return {"ingress": ingress, "reply": reply, "payload": payload}
+
+
+def test_proc_fleet_frame_bytes_under_ceilings(slo, proc_fleet_measured):
+    """Codec creep gate: the wire frames the process transport actually
+    produces (ingress submit + seq-stamped reply) stay under their
+    committed per-request byte ceilings on the fleet mix."""
+    sec, m = slo["process_fleet"], proc_fleet_measured
+    per_req = [i + r for i, r in zip(m["ingress"], m["reply"])]
+    assert max(m["ingress"]) <= sec["ingress_frame_bytes_max"], (
+        f"ingress frame grew to {max(m['ingress'])} B (ceiling "
+        f"{sec['ingress_frame_bytes_max']}) — the submit codec bloated "
+        f"(see SERVE_SLO.json process_fleet._comment)")
+    assert max(m["reply"]) <= sec["reply_frame_bytes_max"], (
+        f"reply frame grew to {max(m['reply'])} B (ceiling "
+        f"{sec['reply_frame_bytes_max']}) — the reply-hub envelope bloated")
+    assert max(per_req) <= sec["wire_bytes_per_request_max"], (
+        f"round-trip wire cost grew to {max(per_req)} B/request "
+        f"(ceiling {sec['wire_bytes_per_request_max']})")
+
+
+def test_proc_fleet_envelope_overhead_under_ceiling(slo,
+                                                    proc_fleet_measured):
+    """The JSON envelope (framing, escaping, the article echoed back in
+    the reply) priced against the payload the caller actually asked to
+    move — uuid + article + summary + reference counted once."""
+    sec, m = slo["process_fleet"], proc_fleet_measured
+    envelope = [i + r - p for i, r, p in
+                zip(m["ingress"], m["reply"], m["payload"])]
+    assert max(envelope) <= sec["envelope_overhead_bytes_max"], (
+        f"wire envelope grew to {max(envelope)} B/request (ceiling "
+        f"{sec['envelope_overhead_bytes_max']}) — schema creep or double "
+        f"encoding in the socket transport")
+
+
+def test_proc_fleet_scrape_bandwidth_under_ceiling(slo):
+    """The supervisor's health scrape, priced at its real cadence: the
+    REAL /healthz payload of a representative replica registry
+    (breakers + heartbeats + serve gauges + ISSUE-17 incarnation
+    identity), serialized once, multiplied by the scrapes/s the
+    serve_scrape_interval_ms default implies."""
+    from textsummarization_on_flink_tpu.obs import http as obs_http
+    from textsummarization_on_flink_tpu.resilience.policy import \
+        CircuitBreaker
+
+    wl = slo["process_fleet"]["workload"]
+    reg = Registry()
+    reg.replica_id = "p0"
+    for name in ("serve.admission", "serve.replica.p0", "io.source"):
+        CircuitBreaker(threshold=2, name=name, registry=reg).allow()
+    for comp in ("serve.engine", "serve.dispatch", "obs.flush"):
+        obs_http.heartbeat(reg, comp)
+    reg.gauge("serve/queue_depth").set(3)
+    payload = obs_http.health(reg)
+    # the incarnation identity the supervisor's readiness check keys on
+    assert payload["pid"] == os.getpid()
+    assert payload["replica_id"] == "p0"
+    assert payload["start_time"] > 0
+    scrape_bytes = len(json.dumps(payload).encode())
+    scrapes_per_s = 1000.0 / wl["scrape_interval_ms"]
+    kib_per_s = scrape_bytes * scrapes_per_s / 1024.0
+    ceiling = slo["process_fleet"]["scrape_kib_per_replica_per_s_max"]
+    assert kib_per_s <= ceiling, (
+        f"health scrape costs {kib_per_s:.2f} KiB/s per replica "
+        f"({scrape_bytes} B at {scrapes_per_s:.0f}/s; ceiling {ceiling}) "
+        f"— the /healthz payload swelled past its scrape budget")
+
+
+def test_proc_fleet_reply_ring_covers_inflight_capacity(slo):
+    """At-least-once floor: a reply ring smaller than one replica's
+    admissible in-flight set could trim frames a reconnecting
+    supervisor never saw.  The hub capacity must dominate the
+    serve_max_queue + slots bound the transport admits against."""
+    from textsummarization_on_flink_tpu.serve import procfleet
+
+    hps = HParams(mode="decode", batch_size=4, vocab_size=8,
+                  max_enc_steps=8, max_dec_steps=4, min_dec_steps=1,
+                  beam_size=2, max_oov_buckets=2,
+                  serve_max_queue=256, serve_slots=8)
+    capacity = hps.serve_max_queue + max(hps.serve_slots,
+                                         hps.serve_max_batch, 1)
+    hub = procfleet._ReplyHub()
+    assert hub.capacity >= capacity, (
+        f"reply ring ({hub.capacity}) smaller than one replica's "
+        f"in-flight capacity ({capacity}) — a reconnect could replay "
+        f"past live work")

@@ -135,6 +135,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "serve-replica":
+        # the process-fleet child entry (ISSUE 17; SERVING.md "Process
+        # fleet"): config arrives as TS_HPS_JSON, ports leave through
+        # the portfile handshake — not a flags-surface mode, so it
+        # dispatches before the reference's 23-flag parse
+        from textsummarization_on_flink_tpu.serve import procfleet
+
+        return procfleet.replica_child_main(argv[1:])
     hps = HParams.from_argv(argv)
     hps.validate()
     log.info("Starting summarization in %s mode...", hps.mode)

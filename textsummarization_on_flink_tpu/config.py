@@ -401,6 +401,26 @@ class HParams:
     # starve another's pickup.  "" = every tenant weighs 1.0 (and a
     # single-tenant queue is exactly the historical FIFO).
     serve_fair_weights: str = ""
+    # ---- multi-process fleet transport (SERVING.md "Process fleet";
+    # ISSUE 17) ----
+    # "inproc" (default): replicas are threads in this process — the
+    # fast path and the test substrate.  "proc": each replica is a
+    # supervised OS child process (serve/procfleet.py, spawned via
+    # `cli.py serve-replica`) reached over loopback sockets, so a
+    # segfault, OOM, or wedged XLA call costs ONE replica, not the
+    # fleet.
+    serve_fleet_transport: str = "inproc"
+    # Hard deadline on every supervisor->child HTTP scrape and ingress
+    # socket connect, in milliseconds: a wedged child costs the router
+    # exactly one timeout (counted in
+    # serve/replica_scrape_errors_total and treated as unhealthy),
+    # never a frozen FleetRouter.tick().
+    serve_scrape_timeout_ms: float = 250.0
+    # Scrape-result cache window in milliseconds: the remote handle
+    # serves healthy()/load() off its last /healthz scrape until it is
+    # this old (the router tick runs every ~5 ms; it must not issue N
+    # HTTP GETs per tick).  0 = scrape on every read.
+    serve_scrape_interval_ms: float = 50.0
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score
@@ -697,6 +717,19 @@ class HParams:
             raise ValueError(
                 f"serve_hedge_max_ratio must be in [0, 1], got "
                 f"{self.serve_hedge_max_ratio}")
+        if self.serve_fleet_transport not in ("inproc", "proc"):
+            raise ValueError(
+                f"serve_fleet_transport must be 'inproc' or 'proc', got "
+                f"{self.serve_fleet_transport!r}")
+        if self.serve_scrape_timeout_ms <= 0:
+            raise ValueError(
+                f"serve_scrape_timeout_ms must be > 0 (every remote "
+                f"scrape needs a hard deadline), got "
+                f"{self.serve_scrape_timeout_ms}")
+        if self.serve_scrape_interval_ms < 0:
+            raise ValueError(
+                f"serve_scrape_interval_ms must be >= 0 (0 = scrape "
+                f"every read), got {self.serve_scrape_interval_ms}")
         if self.faults:
             # parse for validation only (unknown points / bad probs fail
             # here, at config time, not at the injection site)

@@ -81,6 +81,12 @@ LOOP_HEARTBEAT_PERIOD = 120.0
 
 _BREAKER_STATES = {0: "closed", 1: "half_open", 2: "open"}
 
+#: wall-clock start of THIS process, captured at import: with the pid
+#: it forms the /healthz incarnation identity (ISSUE 17) — a replica
+#: supervisor distinguishes a restarted child (new pid/start_time) from
+#: a wedged old one answering on a stale port
+_PROCESS_START_TIME = time.time()
+
 
 class HeartbeatBoard:
     """Component liveness: name -> (last beat, declared period).
@@ -168,6 +174,34 @@ def set_health_info(registry: Registry, **info: Any) -> None:
         current.update(info)
 
 
+#: ceiling on retained incidents per registry: incidents are rare,
+#: page-worthy state transitions (a crash-looping replica), not an
+#: event stream — a bounded deque-style list keeps /alerts small
+_MAX_INCIDENTS = 64
+
+
+def add_incident(registry: Registry, kind: str, **fields: Any) -> None:
+    """File one page-worthy incident (e.g. ``replica_crashloop``) onto
+    `registry`'s /alerts payload (ISSUE 17).  Incidents are the
+    non-SLO alert channel: the burn-rate engine prices request
+    outcomes, while an incident records a STATE the operator must act
+    on (a replica held out of rotation).  No-op when disabled."""
+    if not registry.enabled:
+        return
+    row = {"kind": kind, **fields}
+    current = getattr(registry, "incidents", None)
+    if current is None:
+        registry.incidents = [row]
+    else:
+        current.append(row)
+        del current[:-_MAX_INCIDENTS]
+
+
+def incidents(registry: Registry) -> list:
+    """The registry's filed incidents, newest last ([] when none)."""
+    return list(getattr(registry, "incidents", None) or ())
+
+
 #: gauges the /healthz body surfaces as routing inputs (ISSUE 13: the
 #: FleetRouter's least-loaded pick reads queue depth and free slots off
 #: each replica's health plane — they must be scrapeable, not in-process
@@ -221,6 +255,13 @@ def health(registry: Registry,
         "status": "degraded" if degraded else "ok",
         "components": components,
         "breakers": breakers,
+        # incarnation identity (ISSUE 17): pid + process start time +
+        # stamped replica id let a process supervisor verify WHICH
+        # incarnation answered — a stale portfile pointing at a
+        # previous (or foreign) pid must not pass readiness
+        "pid": os.getpid(),
+        "start_time": _PROCESS_START_TIME,
+        "replica_id": getattr(registry, "replica_id", "") or "",
     }
     if serve:
         payload["serve"] = serve
@@ -315,6 +356,9 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 # the profiler's cached storm/divergence state rides the
                 # same scrape (ISSUE 16) — read-only, like the SLO rows
                 payload["profile"] = profile_lib.profile_alerts(reg)
+                # filed incidents (ISSUE 17): non-SLO page-worthy
+                # states — a crash-looping replica held out of rotation
+                payload["incidents"] = incidents(reg)
                 self._send_json(200, payload)
             elif route == "/profile":
                 # performance attribution plane (obs/profile.py, ISSUE

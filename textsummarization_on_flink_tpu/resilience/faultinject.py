@@ -18,6 +18,10 @@ injection points* compiled into the production code:
   ``serve.cache_fault``  serve/frontdoor.py — summary-cache layer
                       failure (lookups degrade to miss-and-decode,
                       inserts drop; never a wrong summary or a hang)
+  ``serve.proc_kill``  serve/procfleet.py — SIGKILLs one live replica
+                      CHILD PROCESS mid-decode (the supervisor detects
+                      the death, orphans requeue on survivors, the
+                      child restarts under backoff)
   ==================  =====================================================
 
 Arming — either source, same ``point:prob:seed[:max]`` syntax, comma-
@@ -62,6 +66,7 @@ KNOWN_POINTS = (
     "io.connect", "io.read", "io.write",
     "ckpt.load", "train.step_nan", "etl.worker",
     "serve.dispatch", "serve.replica_kill", "serve.cache_fault",
+    "serve.proc_kill",
 )
 
 

@@ -233,8 +233,18 @@ class FleetRouter:
         self._handles: Dict[str, ReplicaHandle] = {}
         self._handle_list: List[ReplicaHandle] = []
         for rid, server in items:
-            h = ReplicaHandle(rid, server, registry=self._reg,
-                              clock=clock, reset_secs=replica_reset_secs)
+            if isinstance(server, ReplicaHandle):
+                # pre-built handle (ISSUE 17: the proc transport's
+                # RemoteReplicaHandle carries its own scrape-cached
+                # health/load reads): adopt it as-is — its id wins over
+                # the positional default
+                h = server
+                rid = h.rid
+                server = h.server
+            else:
+                h = ReplicaHandle(rid, server, registry=self._reg,
+                                  clock=clock,
+                                  reset_secs=replica_reset_secs)
             self._handles[rid] = h
             self._handle_list.append(h)
             # fleet identity (ISSUE 15 satellite): stamp the replica id

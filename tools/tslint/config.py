@@ -132,6 +132,15 @@ DEFAULT: Dict[str, Any] = {
                 r"^Profiler\.(record_compile|record_hit"
                 r"|observe_dispatch)$",
                 r"^compiled_call$",
+                # ISSUE 17: the process-fleet supervision tick and the
+                # remote-handle scrape/rotation reads run at router-tick
+                # cadence against every replica — a device sync inside
+                # any of them multiplies by fleet size per tick
+                r"^ReplicaProcess\.tick$",
+                r"^RemoteReplicaHandle\.(healthy|load)$",
+                r"^RemoteReplica\.(scrape_healthz|_on_reply|load)$",
+                r"^_ReplySource\.rows$",
+                r"^ProcFleet\.(supervise_once|_supervise_loop)$",
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
