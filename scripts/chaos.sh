@@ -226,7 +226,10 @@ echo "== TS_FAULTS sweep: serve.proc_kill (OS-process fleet, SIGKILL failover)"
 # the smoke asserts exactly-once + row parity + typed requeues on
 # survivors + the victim restarted and readmitted through the rotation
 # breaker's half-open probe (full contract in scripts/fleet_smoke.py)
-TS_FAULTS="serve.proc_kill:1.0:0:1" python scripts/fleet_smoke.py \
+# TS_LOCKSAN arms the runtime lock-order sanitizer on the sweep: the
+# kill/requeue path is the richest lock interleaving the repo has, so
+# it doubles as the inversion gate (obs/locksan; zero inversions)
+TS_LOCKSAN=1 TS_FAULTS="serve.proc_kill:1.0:0:1" python scripts/fleet_smoke.py \
   --transport=proc
 
 echo

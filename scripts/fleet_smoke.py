@@ -264,6 +264,27 @@ def run_proc() -> None:
           f"{len(survivors_finished)} finishes witnessed by survivors")
 
 
+def _locksan_gate() -> None:
+    """When TS_LOCKSAN=1 armed the sanitizer, the smoke doubles as the
+    runtime validation of tslint's static lock-order graph: real
+    acquisitions must have been observed and NONE may have inverted
+    (an inversion would already have raised the typed
+    LockOrderInversionError out of the failing path)."""
+    from textsummarization_on_flink_tpu.obs import locksan
+
+    if not locksan.active():
+        return
+    snap = locksan.snapshot()
+    assert snap["acquisitions"] > 0, (
+        "TS_LOCKSAN=1 but the smoke observed no sanitized acquisitions "
+        "— the serve locks are not built through obs/locksan factories")
+    assert snap["inversions"] == 0, snap
+    print(f"locksan OK: {snap['acquisitions']} sanitized acquisitions, "
+          f"0 inversions, {len(snap['order_edges'])} order edge(s), "
+          f"{snap['unmodeled_edges']} unmodeled vs "
+          f"{snap['static_graph'] or 'no static graph'}")
+
+
 def main() -> None:
     transport = "inproc"
     for arg in sys.argv[1:]:
@@ -278,6 +299,7 @@ def main() -> None:
         run_inproc()
     else:
         raise SystemExit(f"unknown transport {transport!r}")
+    _locksan_gate()
 
 
 if __name__ == "__main__":

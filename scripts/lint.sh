@@ -11,7 +11,13 @@
 #      (TS001 jit purity, TS002 host-sync-in-hot-loop, TS003 monotonic
 #      clock, TS004 lock discipline, TS005 broad-except, TS006 donation
 #      aliasing).  Stdlib-only, so it always runs; grandfathered
-#      findings live in tools/tslint/baseline.json.
+#      findings live in tools/tslint/baseline.json.  The scan covers
+#      the package AND tools/ — the analyzer passes its own rules.
+#   3. tools/tslint --rules TS007..TS010 — the interprocedural
+#      concurrency rules (lock-order cycles, blocking-under-lock,
+#      cross-thread unlocked writes, future single-resolution) run as
+#      their own stage so a concurrency regression is named as such in
+#      the gate output, not buried in the per-file sweep.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -33,4 +39,10 @@ EOF
 fi
 
 echo "[lint] tslint (repo-native AST rules, ANALYSIS.md)"
-python -m tools.tslint --baseline tools/tslint/baseline.json
+python -m tools.tslint --baseline tools/tslint/baseline.json \
+  textsummarization_on_flink_tpu tools
+
+echo "[lint] tslint concurrency rules (TS007-TS010, ANALYSIS.md)"
+python -m tools.tslint --rules TS007,TS008,TS009,TS010 \
+  --baseline tools/tslint/baseline.json \
+  textsummarization_on_flink_tpu tools

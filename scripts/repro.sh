@@ -89,6 +89,22 @@ echo "== process-fleet smoke (3 OS child processes, SIGKILL mid-decode)"
 # the suite above; the armed serve.proc_kill sweep is in chaos.sh)
 python scripts/fleet_smoke.py --transport=proc
 
+echo "== locksan smoke (TS_LOCKSAN=1: runtime lock-order sanitizer armed)"
+# the PR-18 dynamic half of tslint's concurrency story: the SAME
+# process-fleet smoke (and one armed proc_kill chaos sweep) with every
+# serve/resilience lock built through obs/locksan, cross-checked
+# against the statically derived lock-order graph — an AB/BA inversion
+# raises the typed LockOrderInversionError instead of deadlocking, and
+# the smoke's _locksan_gate asserts acquisitions > 0 with ZERO
+# inversions (ANALYSIS.md "Concurrency rules")
+LG="$(mktemp /tmp/lockgraph.XXXXXX.json)"
+python -m tools.tslint --lock-graph "$LG" textsummarization_on_flink_tpu tools
+TS_LOCKSAN=1 TS_LOCKSAN_GRAPH="$LG" \
+  python scripts/fleet_smoke.py --transport=proc
+TS_LOCKSAN=1 TS_LOCKSAN_GRAPH="$LG" TS_FAULTS="serve.proc_kill:1.0:0:1" \
+  python scripts/fleet_smoke.py --transport=proc
+rm -f "$LG"
+
 echo "== front-door smoke (coalescing + summary cache on a real model)"
 # the ISSUE-14 front door end to end: a duplicate-heavy burst coalesces
 # onto shared decodes, the warm pass serves byte-identical rows from

@@ -481,3 +481,126 @@ class TestCrashLoop:
             [f"u{i}" for i in range(4)]
         router.stop()
         proc.halt()
+
+
+# -- PR 18: lock discipline on the scrape and ingress paths ----------------
+
+class TestScrapeLockDiscipline:
+    """TS008/TS009 regression (tools/tslint v2): the scrape cache is
+    lock-protected, but the HTTP probe itself must run with NO lock
+    held — a wedged child costs the scraping thread one timeout, never
+    every reader queued behind the scrape lock."""
+
+    def _remote(self, payloads):
+        hps = HParams(serve_scrape_timeout_ms=150.0,
+                      serve_scrape_interval_ms=60_000.0)
+        remote = procfleet.RemoteReplica(
+            "r0", _FakeProc(ports={"obs_port": 1}), hps,
+            registry=Registry())
+        return remote
+
+    def test_http_probe_runs_outside_the_scrape_lock(self, monkeypatch):
+        remote = self._remote(None)
+        held_during_http = []
+
+        def fake_healthz(port, timeout_s):
+            held_during_http.append(remote._scrape_lock.locked())
+            return {"serve": {"params_fingerprint": "fp0"}}
+
+        monkeypatch.setattr(procfleet, "_http_healthz", fake_healthz)
+        assert remote.scrape_healthz() is not None
+        assert held_during_http == [False], (
+            "the HTTP scrape ran WITH the scrape lock held — a wedged "
+            "child would stall every cache reader for the timeout")
+        assert remote.params_fingerprint == "fp0"
+        # cache hit: no second probe inside the window
+        assert remote.scrape_healthz() is not None
+        assert len(held_during_http) == 1
+
+    def test_supervisor_invalidation_races_cleanly_with_a_scrape(
+            self, monkeypatch):
+        """on_child_ready/on_child_death clear the cache under the same
+        lock the scraper writes through: an invalidation landing MID
+        scrape must neither crash nor be silently lost forever — the
+        next read re-probes within one window."""
+        remote = self._remote(None)
+        in_http = threading.Event()
+        release_http = threading.Event()
+
+        def fake_healthz(port, timeout_s):
+            in_http.set()
+            release_http.wait(timeout=5.0)
+            return {"serve": {}}
+
+        monkeypatch.setattr(procfleet, "_http_healthz", fake_healthz)
+        t = threading.Thread(target=remote.scrape_healthz)
+        t.start()
+        assert in_http.wait(timeout=5.0)
+        remote.on_child_death(exit_code=9)  # must not block on the probe
+        release_http.set()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        # last-write-wins is allowed; what is NOT allowed is a wedge or
+        # an exception — and a fresh scrape still works afterward
+        remote.on_child_ready(remote._proc)
+        assert remote.scrape_healthz() is not None
+
+
+class TestIngressLockDiscipline:
+    """TS008 regression: connection ESTABLISHMENT happens with the
+    ingress lock dropped (a refusing/slow child stalls one connector,
+    not every sender); only the sendall stays serialized."""
+
+    def _remote(self, port):
+        hps = HParams(serve_scrape_timeout_ms=200.0)
+        return procfleet.RemoteReplica(
+            "r0", _FakeProc(ports={"ingress_port": port, "obs_port": 1}),
+            hps, registry=Registry())
+
+    def test_connect_runs_outside_the_ingress_lock(self, monkeypatch):
+        srv = socket.create_server(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        remote = self._remote(port)
+        held_during_connect = []
+        real_connect = socket.create_connection
+
+        def spy_connect(addr, timeout=None):
+            held_during_connect.append(remote._ingress_lock.locked())
+            return real_connect(addr, timeout=timeout)
+
+        monkeypatch.setattr(procfleet.socket, "create_connection",
+                            spy_connect)
+        try:
+            remote._send_ingress("hello")
+            conn, _ = srv.accept()
+            conn.settimeout(2.0)
+            assert conn.recv(64) == b"hello\n"
+            conn.close()
+        finally:
+            remote._close_ingress()
+            srv.close()
+        assert held_during_connect == [False], (
+            "socket.create_connection ran WITH _ingress_lock held — "
+            "every sender stalls for the connect timeout")
+
+    def test_refused_connect_still_raises_after_retry(self, monkeypatch):
+        # a dead port: both attempts fail, the typed OSError surfaces,
+        # and the lock is left unheld for the next submit
+        srv = socket.create_server(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        srv.close()  # nothing listens here any more
+        remote = self._remote(port)
+        attempts = []
+        real_connect = socket.create_connection
+
+        def spy_connect(addr, timeout=None):
+            attempts.append(remote._ingress_lock.locked())
+            return real_connect(addr, timeout=timeout)
+
+        monkeypatch.setattr(procfleet.socket, "create_connection",
+                            spy_connect)
+        with pytest.raises(OSError):
+            remote._send_ingress("hello")
+        assert attempts == [False, False]
+        assert not remote._ingress_lock.locked()

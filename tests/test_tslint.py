@@ -630,8 +630,14 @@ def test_committed_baseline_stays_near_empty():
 
 
 def test_every_rule_is_exercised_by_this_suite():
+    # the per-file rules live here; the interprocedural concurrency
+    # rules (TS007–TS010) are covered by tests/test_tslint_concurrency.py
     ids = {r.id for r in RULES}
     assert ids == {"TS001", "TS002", "TS003", "TS004", "TS005", "TS006"}
+    from tools.tslint import ALL_RULES
+
+    assert {r.id for r in ALL_RULES} == ids | {"TS007", "TS008", "TS009",
+                                               "TS010"}
 
 
 if __name__ == "__main__":

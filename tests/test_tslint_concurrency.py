@@ -1,0 +1,763 @@
+"""tools/tslint v2 test suite: interprocedural concurrency rules.
+
+Three layers, mirroring tests/test_tslint.py:
+  * callgraph units — thread-entry inference (Thread targets, Thread
+    subclasses, handler classes, atexit/signal hooks, escaped-callback
+    refs), root propagation, lock identity (Condition aliasing), and
+    the held-on-entry fixpoint;
+  * per-rule fixtures — a positive (the deadlock/race/stall the rule
+    exists for) and a negative (the disciplined version) for each of
+    TS007–TS010, plus inline suppression riding the same machinery;
+  * CLI contract — the seeded-deadlock fixture exits 1, --rules
+    filters, --changed scans the git-diff subset, --write-baseline
+    prunes deleted-file entries, --lock-graph emits the sanitizer's
+    cross-check JSON.
+
+Stdlib-only (ast + subprocess) — none of these tests need jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.tslint import ALL_RULES, PROJECT_RULES, analyze, lock_graph
+from tools.tslint import callgraph
+from tools.tslint.config import merge_config
+from tools.tslint.engine import parse_files
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+PACKAGE = "textsummarization_on_flink_tpu"
+
+CONCURRENCY = {"TS007", "TS008", "TS009", "TS010"}
+
+
+def run_project(tmp_path, files, select=CONCURRENCY, config=None):
+    """Write {name: code} under tmp_path and analyze the tree."""
+    for name, code in files.items():
+        f = tmp_path / name
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(code), encoding="utf-8")
+    return analyze([str(tmp_path)], root=str(tmp_path), select=select,
+                   config=config)
+
+
+def run_snippet(tmp_path, code, **kw):
+    return run_project(tmp_path, {"snippet.py": code}, **kw)
+
+
+def rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+def build_graph(tmp_path, files):
+    for name, code in files.items():
+        (tmp_path / name).write_text(textwrap.dedent(code),
+                                     encoding="utf-8")
+    contexts, parse_findings, _ = parse_files(
+        [str(tmp_path)], str(tmp_path), merge_config(None))
+    assert not parse_findings
+    return callgraph.build(contexts)
+
+
+# --------------------------------------------------------------------------
+# callgraph units
+# --------------------------------------------------------------------------
+
+DEADLOCK = """
+    import threading
+
+    class Transfer:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def deposit(self):
+            with self._a:
+                with self._b:
+                    return 1
+
+        def withdraw(self):
+            with self._b:
+                with self._a:
+                    return 2
+"""
+
+
+def test_callgraph_thread_target_entry(tmp_path):
+    g = build_graph(tmp_path, {"m.py": """
+        import threading
+
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                self._step()
+
+            def _step(self):
+                pass
+    """})
+    loop = g.functions["m.py::Pump._loop"]
+    step = g.functions["m.py::Pump._step"]
+    assert g.roots(loop.fid) == {"thread:Pump._loop"}
+    # reachability: the root flows through the call edge
+    assert g.roots(step.fid) == {"thread:Pump._loop"}
+
+
+def test_callgraph_thread_subclass_run_entry(tmp_path):
+    g = build_graph(tmp_path, {"m.py": """
+        import threading
+
+        class Worker(threading.Thread):
+            def run(self):
+                self._body()
+
+            def _body(self):
+                pass
+    """})
+    assert "thread:Worker.run" in g.roots(g.functions["m.py::Worker._body"].fid)
+
+
+def test_callgraph_handler_class_entry(tmp_path):
+    g = build_graph(tmp_path, {"m.py": """
+        from http.server import BaseHTTPRequestHandler
+
+        class Healthz(BaseHTTPRequestHandler):
+            def do_GET(self):
+                self._reply()
+
+            def _reply(self):
+                pass
+    """})
+    assert "handler:Healthz.do_GET" in g.roots(
+        g.functions["m.py::Healthz._reply"].fid)
+
+
+def test_callgraph_atexit_and_callback_escape_entries(tmp_path):
+    g = build_graph(tmp_path, {"m.py": """
+        import atexit
+
+        class App:
+            def install(self, sink):
+                atexit.register(self._cleanup)
+                sink.on_death = self._on_death
+
+            def _cleanup(self):
+                pass
+
+            def _on_death(self):
+                pass
+    """})
+    assert any(r.startswith("atexit:") for r in g.roots(
+        g.functions["m.py::App._cleanup"].fid))
+    assert any(r.startswith("callback:") for r in g.roots(
+        g.functions["m.py::App._on_death"].fid))
+
+
+def test_callgraph_main_root_for_uncalled_public_method(tmp_path):
+    g = build_graph(tmp_path, {"m.py": """
+        class Api:
+            def public(self):
+                return 1
+    """})
+    assert g.roots(g.functions["m.py::Api.public"].fid) == {callgraph.MAIN_ROOT}
+
+
+def test_callgraph_lock_id_condition_aliases_to_underlying(tmp_path):
+    g = build_graph(tmp_path, {"m.py": """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._not_empty = threading.Condition(self._lock)
+    """})
+    # acquiring the condition IS acquiring the underlying mutex
+    assert g.lock_id("Q", "_not_empty") == "Q._lock"
+    assert g.lock_id("Q", "_lock") == "Q._lock"
+
+
+def test_callgraph_held_on_entry_fixpoint(tmp_path):
+    g = build_graph(tmp_path, {"m.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def outer(self):
+                with self._mu:
+                    self.inner()
+
+            def inner(self):
+                self.leaf()
+
+            def leaf(self):
+                pass
+    """})
+    held = g.held_on_entry()
+    assert held.get("m.py::S.inner") == {"S._mu"}
+    assert held.get("m.py::S.leaf") == {"S._mu"}  # transitive
+    assert not held.get("m.py::S.outer")
+
+
+def test_callgraph_lock_order_edges_cross_method(tmp_path):
+    g = build_graph(tmp_path, {"m.py": DEADLOCK})
+    pairs = {(a, b) for a, b, _, _ in g.lock_order_edges()}
+    assert ("Transfer._a", "Transfer._b") in pairs
+    assert ("Transfer._b", "Transfer._a") in pairs
+
+
+# --------------------------------------------------------------------------
+# TS007 — lock-order-cycle
+# --------------------------------------------------------------------------
+
+def test_ts007_ab_ba_deadlock(tmp_path):
+    r = run_snippet(tmp_path, DEADLOCK)
+    assert rules_of(r) == ["TS007", "TS007"]  # one per inverted edge
+
+
+def test_ts007_cycle_through_helper_call(tmp_path):
+    # the inversion hides behind a call: withdraw acquires B then CALLS
+    # a helper that acquires A — only the held-on-entry fixpoint sees it
+    r = run_snippet(tmp_path, """
+        import threading
+
+        class Transfer:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def deposit(self):
+                with self._a:
+                    with self._b:
+                        return 1
+
+            def withdraw(self):
+                with self._b:
+                    return self._under_a()
+
+            def _under_a(self):
+                with self._a:
+                    return 2
+    """)
+    assert "TS007" in rules_of(r)
+
+
+def test_ts007_consistent_order_is_clean(tmp_path):
+    r = run_snippet(tmp_path, """
+        import threading
+
+        class Transfer:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def deposit(self):
+                with self._a:
+                    with self._b:
+                        return 1
+
+            def withdraw(self):
+                with self._a:
+                    with self._b:
+                        return 2
+    """)
+    assert rules_of(r) == []
+
+
+# --------------------------------------------------------------------------
+# TS008 — blocking-under-lock
+# --------------------------------------------------------------------------
+
+def test_ts008_sleep_under_lock(tmp_path):
+    r = run_snippet(tmp_path, """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poll(self):
+                with self._lock:
+                    time.sleep(0.1)
+    """)
+    assert rules_of(r) == ["TS008"]
+
+
+def test_ts008_blocking_reached_through_helper(tmp_path):
+    # the procfleet shape: the scrape call chain blocks, the lock is
+    # held at the CALL site — the report lands on the held region
+    r = run_snippet(tmp_path, """
+        import socket
+        import threading
+
+        class Scraper:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _fetch(self):
+                return socket.create_connection(("127.0.0.1", 80))
+
+            def scrape(self):
+                with self._lock:
+                    return self._fetch()
+    """)
+    assert rules_of(r) == ["TS008"]
+
+
+def test_ts008_blocking_outside_lock_is_clean(tmp_path):
+    r = run_snippet(tmp_path, """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poll(self):
+                time.sleep(0.1)
+                with self._lock:
+                    return 1
+    """)
+    assert rules_of(r) == []
+
+
+def test_ts008_condition_wait_on_held_lock_is_exempt(tmp_path):
+    # cond.wait() RELEASES the held mutex by contract — the stdlib
+    # Queue discipline must not be flagged
+    r = run_snippet(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._not_empty = threading.Condition(self._lock)
+
+            def get(self):
+                with self._not_empty:
+                    self._not_empty.wait()
+    """)
+    assert rules_of(r) == []
+
+
+# --------------------------------------------------------------------------
+# TS009 — cross-thread-unlocked-write
+# --------------------------------------------------------------------------
+
+def test_ts009_unlocked_write_from_two_roots(tmp_path):
+    r = run_snippet(tmp_path, """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._n = 0
+                self._t = threading.Thread(target=self._work)
+
+            def _work(self):
+                self._n += 1
+
+            def bump(self):
+                self._n += 1
+    """)
+    assert rules_of(r) == ["TS009"]
+
+
+def test_ts009_locked_writes_are_clean(tmp_path):
+    r = run_snippet(tmp_path, """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._work)
+
+            def _work(self):
+                with self._mu:
+                    self._n += 1
+
+            def bump(self):
+                with self._mu:
+                    self._n += 1
+    """)
+    assert rules_of(r) == []
+
+
+def test_ts009_single_root_is_clean(tmp_path):
+    # both writers run on the main thread — no race to report
+    r = run_snippet(tmp_path, """
+        class Counter:
+            def __init__(self):
+                self._n = 0
+
+            def bump(self):
+                self._n += 1
+
+            def reset(self):
+                self._n = 0
+    """)
+    assert rules_of(r) == []
+
+
+def test_ts009_init_helper_writes_are_exempt(tmp_path):
+    # construction-time writers (happens-before Thread.start) don't race
+    r = run_snippet(tmp_path, """
+        import threading
+
+        class Board:
+            def __init__(self):
+                self._init_labels()
+                self._t = threading.Thread(target=self._work)
+
+            def _init_labels(self):
+                self._labels = {}
+
+            def _work(self):
+                with self._mu:
+                    self._labels = {}
+    """)
+    assert rules_of(r) == []
+
+
+def test_ts009_lock_inherited_from_caller_counts(tmp_path):
+    # the write site holds the lock via its caller (held-on-entry), not
+    # lexically — still protected
+    r = run_snippet(tmp_path, """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._work)
+
+            def _work(self):
+                with self._mu:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self._n += 1
+
+            def bump(self):
+                with self._mu:
+                    self._bump_locked()
+    """)
+    assert rules_of(r) == []
+
+
+# --------------------------------------------------------------------------
+# TS010 — future-single-resolution
+# --------------------------------------------------------------------------
+
+def test_ts010_settle_state_written_outside_funnel(tmp_path):
+    r = run_snippet(tmp_path, """
+        import threading
+
+        class Future:
+            def __init__(self):
+                self._event = threading.Event()
+                self._result = None
+
+            def _finish(self, value):
+                self._result = value
+                self._event.set()
+
+            def force(self, value):
+                self._result = value
+                self._event.set()
+    """)
+    assert rules_of(r) == ["TS010", "TS010"]  # state write + event fire
+
+
+def test_ts010_funnel_discipline_is_clean(tmp_path):
+    r = run_snippet(tmp_path, """
+        import threading
+
+        class Future:
+            def __init__(self):
+                self._event = threading.Event()
+                self._result = None
+
+            def _finish(self, value):
+                self._result = value
+                self._event.set()
+
+            def resolve(self, value):
+                self._finish(value)
+
+            def reject(self, err):
+                self._finish(err)
+    """)
+    assert rules_of(r) == []
+
+
+def test_ts010_resolver_without_settle_guard(tmp_path):
+    # clause B: offer() writes the first-wins flag, force() settles the
+    # member future WITHOUT it — the hedging double-resolve shape
+    r = run_snippet(tmp_path, """
+        class Routed:
+            def __init__(self, fut):
+                self._settled = False
+                self.future = fut
+
+            def offer(self, value):
+                if not self._settled:
+                    self._settled = True
+                    self.future._resolve(value)
+
+            def force(self, err):
+                self.future._reject(err)
+    """)
+    assert rules_of(r) == ["TS010"]
+
+
+def test_ts010_guarded_resolvers_are_clean(tmp_path):
+    r = run_snippet(tmp_path, """
+        class Routed:
+            def __init__(self, fut):
+                self._settled = False
+                self.future = fut
+
+            def offer(self, value):
+                if not self._settled:
+                    self._settled = True
+                    self.future._resolve(value)
+
+            def force(self, err):
+                if not self._settled:
+                    self._settled = True
+                    self.future._reject(err)
+    """)
+    assert rules_of(r) == []
+
+
+# --------------------------------------------------------------------------
+# suppression + reporting plumbing
+# --------------------------------------------------------------------------
+
+def test_project_rule_inline_suppression(tmp_path):
+    r = run_snippet(tmp_path, """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poll(self):
+                with self._lock:
+                    time.sleep(0.1)  # tslint: disable=TS008 -- fixture
+    """)
+    assert rules_of(r) == []
+    assert r.suppressed == 1
+
+
+def test_concurrency_findings_span_files(tmp_path):
+    # the inversion is only visible when BOTH files are in the graph
+    r = run_project(tmp_path, {
+        "a.py": """
+            import threading
+
+            class Transfer:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def deposit(self):
+                    with self._a:
+                        with self._b:
+                            return 1
+        """,
+        "b.py": """
+            class Drain:
+                def run(self, t):
+                    with t._b:
+                        with t._a:
+                            return 2
+        """,
+    })
+    # cross-file attribute locks resolve only for self.<attr>; the
+    # SAME-class inversion in a.py alone must stay clean
+    ra = analyze([str(tmp_path / "a.py")], root=str(tmp_path),
+                 select=CONCURRENCY)
+    assert rules_of(ra) == []
+    assert r.files == 2
+
+
+# --------------------------------------------------------------------------
+# CLI contract
+# --------------------------------------------------------------------------
+
+def _cli(args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.tslint", *args],
+        cwd=cwd, capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT})
+
+
+def _write(tmp_path, name, code):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(code), encoding="utf-8")
+    return f
+
+
+def test_cli_seeded_deadlock_exits_1(tmp_path):
+    bug = _write(tmp_path, "bug.py", DEADLOCK)
+    proc = _cli(["--no-baseline", "--root", str(tmp_path), str(bug)])
+    assert proc.returncode == 1
+    assert "TS007" in proc.stdout
+
+
+def test_cli_rules_filter(tmp_path):
+    # the fixture trips TS007 AND TS003 (time.time); --rules must hide
+    # the rules not selected
+    bug = _write(tmp_path, "bug.py", DEADLOCK + """
+    def stamp(t0):
+        import time
+        return time.time() - t0
+    """)
+    proc = _cli(["--no-baseline", "--root", str(tmp_path),
+                 "--rules", "TS003", str(bug)])
+    assert proc.returncode == 1
+    assert "TS003" in proc.stdout and "TS007" not in proc.stdout
+    proc = _cli(["--no-baseline", "--root", str(tmp_path),
+                 "--rules", "TS007,TS008", str(bug)])
+    assert proc.returncode == 1
+    assert "TS007" in proc.stdout and "TS003" not in proc.stdout
+
+
+def _git(tmp_path, *args):
+    return subprocess.run(
+        ["git", *args], cwd=str(tmp_path), capture_output=True, text=True,
+        env={**os.environ,
+             "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+             "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+def test_cli_changed_scans_only_the_diff(tmp_path):
+    assert _git(tmp_path, "init", "-q").returncode == 0
+    _write(tmp_path, "clean.py", """
+        import time
+
+        def f(t0):
+            return time.time() - t0
+    """)
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # clean.py has a TS003 at HEAD; the NEW file carries a TS007
+    _write(tmp_path, "fresh.py", DEADLOCK)
+    proc = _cli(["--no-baseline", "--root", str(tmp_path),
+                 "--changed", "HEAD", "."])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "fresh.py" in proc.stdout
+    assert "clean.py" not in proc.stdout  # unchanged vs HEAD — skipped
+
+
+def test_cli_changed_with_no_changes_exits_0(tmp_path):
+    assert _git(tmp_path, "init", "-q").returncode == 0
+    _write(tmp_path, "a.py", "X = 1\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    proc = _cli(["--no-baseline", "--root", str(tmp_path),
+                 "--changed", "HEAD", "."])
+    assert proc.returncode == 0
+    assert "no changed python files" in proc.stdout
+
+
+def test_cli_write_baseline_prunes_deleted_files(tmp_path):
+    doomed = _write(tmp_path, "doomed.py", """
+        import time
+
+        def f(t0):
+            return time.time() - t0
+    """)
+    keeper = _write(tmp_path, "keeper.py", DEADLOCK)
+    bl = tmp_path / "bl.json"
+    proc = _cli(["--root", str(tmp_path), "--baseline", str(bl),
+                 "--write-baseline", str(tmp_path)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    entries = json.loads(bl.read_text())["findings"]
+    assert {e["path"] for e in entries} == {"doomed.py", "keeper.py"}
+    # the file dies; a rewrite scanning ONLY keeper.py must still drop
+    # the stale doomed.py debt instead of carrying it forever
+    doomed.unlink()
+    proc = _cli(["--root", str(tmp_path), "--baseline", str(bl),
+                 "--write-baseline", str(keeper)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pruned" in proc.stdout
+    entries = json.loads(bl.read_text())["findings"]
+    assert {e["path"] for e in entries} == {"keeper.py"}
+
+
+def test_cli_write_baseline_carries_unscanned_files(tmp_path):
+    _write(tmp_path, "a.py", DEADLOCK)
+    _write(tmp_path, "b.py", """
+        import time
+
+        def f(t0):
+            return time.time() - t0
+    """)
+    bl = tmp_path / "bl.json"
+    _cli(["--root", str(tmp_path), "--baseline", str(bl),
+          "--write-baseline", str(tmp_path)])
+    before = {e["path"] for e in json.loads(bl.read_text())["findings"]}
+    assert before == {"a.py", "b.py"}
+    # subset rewrite: a.py's debt must survive a b.py-only scan
+    proc = _cli(["--root", str(tmp_path), "--baseline", str(bl),
+                 "--write-baseline", str(tmp_path / "b.py")])
+    assert "carried" in proc.stdout
+    after = {e["path"] for e in json.loads(bl.read_text())["findings"]}
+    assert after == {"a.py", "b.py"}
+
+
+def test_cli_lock_graph_output(tmp_path):
+    _write(tmp_path, "m.py", DEADLOCK)
+    out = tmp_path / "graph.json"
+    proc = _cli(["--root", str(tmp_path), "--lock-graph", str(out),
+                 str(tmp_path)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(out.read_text())
+    assert payload["tool"] == "tslint"
+    assert set(payload["locks"]) == {"Transfer._a", "Transfer._b"}
+    edges = {tuple(e) for e in payload["edges"]}
+    assert ("Transfer._a", "Transfer._b") in edges
+    assert ("Transfer._b", "Transfer._a") in edges
+
+
+def test_lock_graph_api_matches_repo_locks():
+    payload = lock_graph([PACKAGE], root=REPO_ROOT)
+    # the sanitizer names its locks Class.attr — the graph must carry
+    # the real serving locks the smokes exercise
+    assert "RequestQueue._lock" in payload["locks"]
+    assert "RemoteReplica._ingress_lock" in payload["locks"]
+
+
+# --------------------------------------------------------------------------
+# rule registry
+# --------------------------------------------------------------------------
+
+def test_project_rule_registry():
+    assert [r.id for r in PROJECT_RULES] == ["TS007", "TS008", "TS009",
+                                             "TS010"]
+    ids = {r.id for r in ALL_RULES}
+    assert ids == {f"TS{i:03d}" for i in range(1, 11)}
+
+
+def test_repo_tools_tree_is_clean_on_concurrency_rules():
+    # the analyzer's own code (and the whole package) must pass the
+    # concurrency rules it enforces — the lint.sh stage-3 gate, in-proc
+    result = analyze([PACKAGE, "tools"], root=REPO_ROOT,
+                     select=CONCURRENCY)
+    assert result.findings == [], "\n".join(
+        f.format_text() for f in result.findings)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -31,6 +31,7 @@ from typing import Any, Callable, Iterator, Optional, Tuple, Type
 
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.obs import flightrec
+from textsummarization_on_flink_tpu.obs import locksan
 from textsummarization_on_flink_tpu.resilience.errors import (
     CircuitOpenError,
     DeadlineExceededError,
@@ -147,8 +148,8 @@ class RetryPolicy:
         """Record a failed attempt.  Raises RetriesExhaustedError (cause
         chained) when the budget is spent — callers in generator style
         call this from their except block."""
-        self._failures += 1
-        self._last_error = err
+        self._failures += 1  # tslint: disable=TS009 — a RetryPolicy instance is confined to ONE attempt loop; the reader-thread root is a different instance
+        self._last_error = err  # tslint: disable=TS009 — same confinement: per-call-site instance, never shared across the roots the analyzer unions
         if self._failures >= self.max_attempts:
             self._c_exhausted.inc()
             raise RetriesExhaustedError(
@@ -224,7 +225,7 @@ class CircuitBreaker:
         self.reset_secs = reset_secs
         self.name = name
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("CircuitBreaker._lock")
         self._state = self.CLOSED
         self._failures = 0  # consecutive, in CLOSED
         self._opened_at = 0.0

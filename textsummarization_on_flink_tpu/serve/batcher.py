@@ -317,7 +317,7 @@ class ContinuousBatcher:
             req.future._reject(DeadlineExceededError(
                 f"request {req.uuid!r} deadline expired after "
                 f"{self._chunks[idx]} resident chunk(s)"))
-        self._tick_evictions += evicted
+        self._tick_evictions += evicted  # tslint: disable=TS009 — single-writer: only the dispatch thread ticks; the main root is the single-threaded virtual-time tests
         if evicted >= max(2, (self.slots + 1) // 2):
             # an eviction STORM (half the engine thrown away at one
             # boundary) is a latency incident, not routine aging: leave
@@ -462,7 +462,7 @@ class ContinuousBatcher:
                 self._resident[idx] = req
                 self._chunks[idx] = 0
                 self._c_refills.inc()
-                self._tick_refills += 1
+                self._tick_refills += 1  # tslint: disable=TS009 — single-writer dispatch-thread invariant (see _tick_evictions)
                 # the refill-into-slot lifecycle event: WHICH slot at
                 # WHICH tick — the datum aggregate histograms cannot
                 # answer ("why was uuid X slow?")
@@ -515,7 +515,7 @@ class ContinuousBatcher:
         Returns False when the engine stayed idle (nothing resident and
         nothing arrived within `poll`) so the caller's loop can re-check
         its stop flag without spinning."""
-        self._tick += 1
+        self._tick += 1  # tslint: disable=TS009 — single-writer dispatch-thread invariant (see _tick_evictions)
         self._tick_evictions = 0
         self._tick_refills = 0
         # the per-tick wall bracket (obs/profile.py, ISSUE 16) closes

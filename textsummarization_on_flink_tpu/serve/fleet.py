@@ -69,6 +69,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.obs import flightrec
 from textsummarization_on_flink_tpu.obs import http as obs_http
+from textsummarization_on_flink_tpu.obs import locksan
 from textsummarization_on_flink_tpu.obs import slo as slo_lib
 from textsummarization_on_flink_tpu.resilience import faultinject
 from textsummarization_on_flink_tpu.serve.errors import (
@@ -123,7 +124,7 @@ class _Routed:
         self._outstanding = 0
         self._settled = False
         self._last_error: Optional[BaseException] = None
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("_Routed._lock")
 
     def add_outstanding(self) -> None:
         with self._lock:
@@ -285,7 +286,7 @@ class FleetRouter:
         for h in self._handle_list:
             if hasattr(h.server, "disable_front_door"):
                 h.server.disable_front_door()
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("FleetRouter._lock")
         self._inflight: List[_Routed] = []
         self._n_submitted = 0
         self._n_hedges = 0

@@ -73,6 +73,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from textsummarization_on_flink_tpu import obs
 from textsummarization_on_flink_tpu.config import resolve_tenant_burst
 from textsummarization_on_flink_tpu.obs import flightrec
+from textsummarization_on_flink_tpu.obs import locksan
 from textsummarization_on_flink_tpu.resilience import faultinject
 from textsummarization_on_flink_tpu.serve.errors import (
     TenantThrottledError,
@@ -196,7 +197,7 @@ class SummaryCache:
         self.max_entries = max_entries
         self.max_bytes = max_bytes
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("SummaryCache._lock")
         self._entries: "OrderedDict[Tuple[str, str, str], _CacheEntry]" = \
             OrderedDict()
         self._bytes = 0
@@ -309,7 +310,7 @@ class FrontDoor:
         self._rate = float(getattr(hps, "serve_tenant_rate", 0.0))
         self._burst = float(resolve_tenant_burst(hps)) if self._rate > 0 \
             else 0.0
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("FrontDoor._lock")
         self._flights: Dict[Tuple[str, str], _Flight] = {}
         self._tenants: "OrderedDict[str, _TokenBucket]" = OrderedDict()
         # the submit hot path tests ONE bool when nothing is armed

@@ -75,6 +75,7 @@ from textsummarization_on_flink_tpu.config import HParams
 from textsummarization_on_flink_tpu.obs import export as obs_export
 from textsummarization_on_flink_tpu.obs import flightrec
 from textsummarization_on_flink_tpu.obs import http as obs_http
+from textsummarization_on_flink_tpu.obs import locksan
 from textsummarization_on_flink_tpu.pipeline.io import Message, ResilientSource
 from textsummarization_on_flink_tpu.resilience import faultinject
 from textsummarization_on_flink_tpu.resilience.policy import (
@@ -221,7 +222,7 @@ class ReplicaProcess:
             "serve/replica_restarts_total").labels(replica=rid)
         self._c_crashloops = self._reg.counter(
             "serve/replica_crashloops_total").labels(replica=rid)
-        self._lock = threading.RLock()
+        self._lock = locksan.make_rlock("ReplicaProcess._lock")
         self.state = self.IDLE
         self.proc: Optional[subprocess.Popen] = None
         self.incarnation = 0
@@ -608,12 +609,16 @@ class RemoteReplica:
             "serve/replica_scrape_errors_total").labels(replica=rid)
         self._c_malformed = self._router_reg.counter(
             "serve/replica_reply_malformed_total").labels(replica=rid)
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("RemoteReplica._lock")
         self._pending: Dict[str, List[Tuple[ServeFuture, str, str, str]]] = {}
         self._killed = False
         self._closed = False
-        self._ingress_lock = threading.Lock()
+        self._ingress_lock = locksan.make_lock("RemoteReplica._ingress_lock")
         self._ingress_sock: Optional[socket.socket] = None
+        # guards the scrape cache + fingerprint (written by the router
+        # thread AND the supervisor callbacks); the HTTP scrape itself
+        # runs OUTSIDE it — a wedged child must not stall cache readers
+        self._scrape_lock = locksan.make_lock("RemoteReplica._scrape_lock")
         self._reader: Optional[threading.Thread] = None
         self._reader_stop = threading.Event()
         self._reply_sock: Optional[socket.socket] = None
@@ -766,21 +771,26 @@ class RemoteReplica:
         ``serve_scrape_timeout_ms`` wait per ``serve_scrape_interval_ms``
         window, never a timeout per router tick."""
         now = self._clock()
-        if (self._scrape_cache_t >= 0.0
-                and now - self._scrape_cache_t < self._scrape_interval_s):
-            return self._scrape_cache
+        with self._scrape_lock:
+            if (self._scrape_cache_t >= 0.0
+                    and now - self._scrape_cache_t < self._scrape_interval_s):
+                return self._scrape_cache
+        # cache miss: scrape with NO lock held (two racing misses cost
+        # one duplicate probe, last-write-wins — cheaper than every
+        # reader waiting out a wedged child's timeout behind the lock)
         payload = None
         ports = self._proc.ports()
         if ports is not None:
             payload = _http_healthz(int(ports["obs_port"]), self._timeout_s)
         if payload is None:
             self._c_scrape_errors.inc()
-        else:
-            fp = payload.get("serve", {}).get("params_fingerprint", "")
-            if fp:
-                self._fingerprint = fp
-        self._scrape_cache = payload
-        self._scrape_cache_t = now
+        with self._scrape_lock:
+            if payload is not None:
+                fp = payload.get("serve", {}).get("params_fingerprint", "")
+                if fp:
+                    self._fingerprint = fp
+            self._scrape_cache = payload
+            self._scrape_cache_t = now
         return payload
 
     @property
@@ -793,8 +803,9 @@ class RemoteReplica:
         """Supervisor readiness notification: drop the (negative) scrape
         cache so the router's next health probe sees the fresh
         incarnation instead of waiting out the cache window."""
-        self._scrape_cache = None
-        self._scrape_cache_t = -1.0
+        with self._scrape_lock:
+            self._scrape_cache = None
+            self._scrape_cache_t = -1.0
 
     def on_child_death(self, exit_code: Optional[int]) -> None:
         """Supervisor death notification: every in-flight future fails
@@ -808,8 +819,9 @@ class RemoteReplica:
             log.warning("replica %s: failed %d in-flight request(s) on "
                         "child death", self.rid, n)
         self._close_ingress()
-        self._scrape_cache = None
-        self._scrape_cache_t = -1.0  # next health read scrapes fresh
+        with self._scrape_lock:
+            self._scrape_cache = None
+            self._scrape_cache_t = -1.0  # next health read scrapes fresh
         h = self.handle
         if (h is not None and not h.killed
                 and h.breaker.state == CircuitBreaker.CLOSED):
@@ -833,24 +845,38 @@ class RemoteReplica:
 
     def _send_ingress(self, line: str) -> None:
         data = (line + "\n").encode("utf-8")
-        with self._ingress_lock:
-            for attempt in (0, 1):
-                try:
-                    if self._ingress_sock is None:
-                        ports = self._proc.ports()
-                        if ports is None:
-                            raise ConnectionRefusedError(
-                                "ingress port not published")
-                        self._ingress_sock = socket.create_connection(
-                            (LOOPBACK, int(ports["ingress_port"])),
-                            timeout=self._timeout_s)
-                        self._ingress_sock.settimeout(self._timeout_s)
-                    self._ingress_sock.sendall(data)
-                    return
-                except OSError:
+        for attempt in (0, 1):
+            try:
+                with self._ingress_lock:
+                    sock = self._ingress_sock
+                if sock is None:
+                    # connect with NO lock held: a slow or refusing
+                    # child costs the connecting thread one timeout,
+                    # not every sender queued behind the lock (TS008)
+                    ports = self._proc.ports()
+                    if ports is None:
+                        raise ConnectionRefusedError(
+                            "ingress port not published")
+                    fresh = socket.create_connection(
+                        (LOOPBACK, int(ports["ingress_port"])),
+                        timeout=self._timeout_s)
+                    fresh.settimeout(self._timeout_s)
+                    with self._ingress_lock:
+                        if self._ingress_sock is None:
+                            self._ingress_sock = fresh
+                        else:
+                            fresh.close()  # racing connector won
+                with self._ingress_lock:
+                    sock = self._ingress_sock
+                    if sock is None:
+                        raise OSError("ingress socket closed mid-send")
+                    sock.sendall(data)  # tslint: disable=TS008 — one socket, interleaving-free framing REQUIRES serializing writers; bounded by settimeout(_timeout_s) above
+                return
+            except OSError:
+                with self._ingress_lock:
                     self._close_ingress_locked()
-                    if attempt:
-                        raise
+                if attempt:
+                    raise
 
     def _close_ingress(self) -> None:
         with self._ingress_lock:
@@ -1146,7 +1172,7 @@ class _ReplyHub:
 
     def __init__(self, capacity: int = 65536):
         self._capacity = capacity
-        self._cv = threading.Condition()
+        self._cv = locksan.make_condition("_ReplyHub._cv")
         self._frames: List[str] = []
         self._base = 0  # absolute seq of _frames[0]
         self._next_seq = 0

@@ -39,6 +39,7 @@ from collections import OrderedDict, deque
 from typing import Any, Callable, Deque, Dict, List, Optional
 
 from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.obs import locksan
 from textsummarization_on_flink_tpu.resilience.policy import (
     CircuitBreaker,
     Deadline,
@@ -133,7 +134,7 @@ class ServeFuture:
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("ServeFuture._lock")
         self._callbacks: List[Callable[["ServeFuture"], None]] = []
         self._registry = registry if registry is not None else obs.registry()
 
@@ -288,7 +289,7 @@ class RequestQueue:
         # ONE waiter per transition — notify_all here would cost
         # O(waiters) context switches per request under the
         # high-concurrency load the serve bench measures
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("RequestQueue._lock")
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
         self._buckets: "OrderedDict[str, Deque[ServeRequest]]" = \

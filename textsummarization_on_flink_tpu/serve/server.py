@@ -427,7 +427,7 @@ class ServingServer:
             return
         fp = self.params_fingerprint
         if fp != self._published_fp:
-            self._published_fp = fp
+            self._published_fp = fp  # tslint: disable=TS009 — written only by whichever single loop (dispatch or tick_once) owns this server; roots never coexist
             obs_http.set_health_info(self._reg, params_fingerprint=fp)
 
     def idle(self) -> bool:

@@ -1,10 +1,13 @@
 """tslint: repo-native static analysis for the failure classes ruff's
 E/F/W set cannot see (ANALYSIS.md).
 
-Rules: TS001 jit-purity, TS002 host-sync-in-hot-loop, TS003
+Per-file rules: TS001 jit-purity, TS002 host-sync-in-hot-loop, TS003
 monotonic-clock, TS004 lock-discipline, TS005 broad-except, TS006
-donation-aliasing.  Stdlib-only (``ast``): no third-party dependency,
-same no-network constraint as scripts/lint.sh.
+donation-aliasing.  Interprocedural concurrency rules (v2, riding the
+callgraph.py thread/lock model): TS007 lock-order-cycle, TS008
+blocking-under-lock, TS009 cross-thread-unlocked-write, TS010
+future-single-resolution.  Stdlib-only (``ast``): no third-party
+dependency, same no-network constraint as scripts/lint.sh.
 
 API:
     from tools.tslint import analyze            # engine entry
@@ -16,9 +19,14 @@ from tools.tslint.engine import (  # noqa: F401
     Finding,
     analyze,
     load_baseline,
+    lock_graph,
     match_baseline,
     write_baseline,
 )
 from tools.tslint.rules import RULES  # noqa: F401
+from tools.tslint.concurrency import PROJECT_RULES  # noqa: F401
 
-__version__ = "1.0"
+#: per-file rules + interprocedural concurrency rules, in id order
+ALL_RULES = tuple(RULES) + tuple(PROJECT_RULES)
+
+__version__ = "2.0"

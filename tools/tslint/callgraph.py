@@ -1,0 +1,618 @@
+"""Interprocedural layer for tslint: a package-wide call graph with
+thread-entry inference and lock-region analysis.
+
+The per-file rules (TS001–TS006) see one AST at a time; the concurrency
+rules (TS007–TS010, tools/tslint/concurrency.py) need to know *who calls
+whom from which thread while holding which lock*.  This module builds
+that picture from the same annotated ASTs — stdlib-``ast`` only, best
+effort by design: resolution that cannot be decided statically produces
+NO edge (under-approximate calls) but DOES count unknown callback
+registrations as potential thread roots (over-approximate concurrency),
+which is the right polarity for a race detector.
+
+What is modelled:
+
+* **Functions** — module-level ``def``s and methods of top-level
+  classes.  Nested closures are folded into their owner (their calls
+  and blocking primitives belong to the enclosing function for
+  reachability; their bodies are *excluded* from lexical lock regions,
+  since a closure runs later, on whatever thread invokes it).
+* **Call edges** — resolved through: ``self.method()`` (including
+  single-inheritance lookup), bare names (same module, or imported via
+  ``from x import f``), ``ClassName(...)`` → ``__init__``, and
+  ``self.attr.method()`` / ``var.method()`` where the attr/var was
+  assigned ``ClassName(...)`` (constructor type inference).
+* **Thread entries** — ``threading.Thread(target=...)``, ``Thread``
+  subclasses' ``run``, ``*RequestHandler`` subclasses' ``do_*`` /
+  ``handle`` methods, ``atexit.register`` / ``signal.signal`` hooks,
+  and escaped method references (``obj.attr = self._cb`` or an
+  ``on_*=``/``callback=`` keyword) — each escape site is its own
+  potential root, because a stored callback may fire on any thread.
+* **Lock regions** — per-class lock attributes (``self._x =
+  threading.Lock()`` / ``RLock`` / ``Condition`` or the
+  ``obs.locksan`` factories), ``Condition(self._lock)`` aliasing back
+  to the underlying lock, lexical ``with self._lock:`` nesting, and a
+  *transitive lock-held fixpoint*: if ``f`` calls ``g`` while holding
+  ``L``, then ``g`` (and everything it calls) may run with ``L`` held.
+
+Lock identity is ``ClassName.attr`` after condition aliasing — the same
+naming the runtime sanitizer (obs/locksan.py) uses, so the statically
+derived order graph and the runtime acquisition order cross-check.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+#: rsplit(".")[-1] factory names that mark ``self.x = <factory>(...)``
+#: as a lock attribute (threading stdlib + the obs/locksan wrappers).
+LOCK_FACTORIES = ("Lock", "RLock", "Condition",
+                  "make_lock", "make_rlock", "make_condition")
+_CONDITION_FACTORIES = ("Condition", "make_condition")
+
+#: base-class name fragments whose subclasses' handler methods run on
+#: server-spawned threads (ThreadingHTTPServer and socketserver kin).
+_HANDLER_BASE_FRAGMENTS = ("RequestHandler",)
+
+#: keyword names whose argument, when it is a resolvable function
+#: reference, is treated as an escaping callback (potential thread root).
+_CALLBACK_KWARG_NAMES = ("callback", "cb", "on_done")
+
+MAIN_ROOT = "main"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    fid: str                       # "relpath::Class.method" | "relpath::func"
+    relpath: str
+    qualname: str                  # "Class.method" | "func"
+    name: str
+    class_name: Optional[str]
+    node: ast.AST
+    ctx: Any                       # engine.FileContext
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    relpath: str
+    node: ast.ClassDef
+    bases: List[str]
+    methods: Dict[str, FuncInfo]
+    lock_attrs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: condition attr -> underlying lock attr (itself when the condition
+    #: owns its lock): ``self._nf = Condition(self._lock)`` -> _lock
+    cond_underlying: Dict[str, str] = dataclasses.field(default_factory=dict)
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class CallSite:
+    caller: str                    # fid
+    callee: str                    # fid
+    node: ast.AST
+    in_closure: bool               # inside a nested def/lambda of caller
+
+
+class CallGraph:
+    """The package-wide model; built once per analyze() run."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}       # unique name -> info
+        self._ambiguous_classes: Set[str] = set()
+        self.edges: Dict[str, List[CallSite]] = {}
+        self.callers: Dict[str, List[CallSite]] = {}
+        #: fid -> labels like "thread:Class._run", "handler:H.do_GET",
+        #: "atexit:fn", "signal:fn", "callback:<registration scope>"
+        self.entry_labels: Dict[str, Set[str]] = {}
+        self._roots: Optional[Dict[str, Set[str]]] = None
+        self._held: Optional[Dict[str, Set[str]]] = None
+        #: fid -> {lock -> (caller fid, line)} provenance for held locks
+        self.held_via: Dict[str, Dict[str, Tuple[str, int]]] = {}
+
+    # -- identity helpers ---------------------------------------------------
+
+    def func(self, fid: str) -> FuncInfo:
+        return self.functions[fid]
+
+    def lock_id(self, class_name: str, attr: str) -> Optional[str]:
+        ci = self.classes.get(class_name)
+        if ci is None:
+            return None
+        attr = ci.cond_underlying.get(attr, attr)
+        if attr in ci.lock_attrs:
+            return f"{class_name}.{attr}"
+        return None
+
+    # -- thread-root reachability -------------------------------------------
+
+    def roots(self, fid: str) -> Set[str]:
+        """Thread roots this function may run under.  A function with no
+        entry label reaching it runs on whatever called into the package
+        — the synthetic ``main`` root."""
+        if self._roots is None:
+            self._roots = self._compute_roots()
+        return self._roots.get(fid, {MAIN_ROOT})
+
+    def _compute_roots(self) -> Dict[str, Set[str]]:
+        reach: Dict[str, Set[str]] = {}
+        for fid in self.functions:
+            labels = set(self.entry_labels.get(fid, ()))
+            if not labels and not self.callers.get(fid):
+                labels = {MAIN_ROOT}
+            reach[fid] = labels
+        self._propagate(reach)
+        # call cycles with no outside caller never got seeded: they run
+        # under whatever called into the package — main — and so do
+        # their callees (second fixpoint)
+        leftover = [fid for fid, labels in reach.items() if not labels]
+        if leftover:
+            for fid in leftover:
+                reach[fid].add(MAIN_ROOT)
+            self._propagate(reach)
+        return reach
+
+    def _propagate(self, reach: Dict[str, Set[str]]) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for fid, sites in self.edges.items():
+                src = reach.get(fid, ())
+                if not src:
+                    continue  # not yet reached — nothing to push
+                for s in sites:
+                    dst = reach.setdefault(s.callee, set())
+                    before = len(dst)
+                    dst |= src
+                    if len(dst) != before:
+                        changed = True
+
+    # -- lock regions --------------------------------------------------------
+
+    def _lock_of_expr(self, expr: ast.AST, finfo: FuncInfo) -> Optional[str]:
+        """Canonical lock id for ``self._x`` when _x is a (condition-
+        aliased) lock attr of the owning class."""
+        if finfo.class_name is None:
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return self.lock_id(finfo.class_name, expr.attr)
+        return None
+
+    def in_closure(self, node: ast.AST, finfo: FuncInfo) -> bool:
+        cur = getattr(node, "_ts_parent", None)
+        while cur is not None and cur is not finfo.node:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return True
+            cur = getattr(cur, "_ts_parent", None)
+        return False
+
+    def lexical_locks(self, finfo: FuncInfo, node: ast.AST) -> List[str]:
+        """Locks held at `node` by enclosing ``with self._x:`` blocks of
+        the same function (innermost last).  Empty inside closures — a
+        nested def's body runs later, outside these regions."""
+        if self.in_closure(node, finfo):
+            return []
+        out: List[str] = []
+        cur = getattr(node, "_ts_parent", None)
+        prev: ast.AST = node
+        while cur is not None and cur is not finfo.node:
+            # a node still inside a withitem (the context expr itself)
+            # runs BEFORE that with-block's locks are held
+            if isinstance(cur, ast.With) and not isinstance(
+                    prev, ast.withitem):
+                for item in cur.items:
+                    lid = self._lock_of_expr(item.context_expr, finfo)
+                    if lid is not None and lid not in out:
+                        out.append(lid)
+            prev = cur
+            cur = getattr(cur, "_ts_parent", None)
+        out.reverse()  # outermost first
+        return out
+
+    def acquisition_sites(self, finfo: FuncInfo) -> List[Tuple[str, ast.AST]]:
+        """(lock id, node) for every ``with self._x:`` item and every
+        ``self._x.acquire()`` call in the function body (closures
+        excluded — they acquire on their own thread's schedule)."""
+        out: List[Tuple[str, ast.AST]] = []
+        for node in ast.walk(finfo.node):
+            if self.in_closure(node, finfo):
+                continue
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = self._lock_of_expr(item.context_expr, finfo)
+                    if lid is not None:
+                        out.append((lid, item.context_expr))
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                lid = self._lock_of_expr(node.func.value, finfo)
+                if lid is not None:
+                    out.append((lid, node))
+        return out
+
+    def held_on_entry(self) -> Dict[str, Set[str]]:
+        """Transitive lock-held fixpoint: held_on_entry[g] is the union
+        over call sites (f -> g) of (locks lexically held at the site
+        plus held_on_entry[f]).  May-hold semantics."""
+        if self._held is not None:
+            return self._held
+        held: Dict[str, Set[str]] = {fid: set() for fid in self.functions}
+        via: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        changed = True
+        while changed:
+            changed = False
+            for fid, sites in self.edges.items():
+                finfo = self.functions[fid]
+                base = held.get(fid, set())
+                for s in sites:
+                    at_site = set(self.lexical_locks(finfo, s.node)) | base
+                    dst = held.setdefault(s.callee, set())
+                    for lock in at_site:
+                        if lock not in dst:
+                            dst.add(lock)
+                            via.setdefault(s.callee, {}).setdefault(
+                                lock, (fid, getattr(s.node, "lineno", 0)))
+                            changed = True
+        self._held = held
+        self.held_via = via
+        return held
+
+    def lock_order_edges(self) -> List[Tuple[str, str, FuncInfo, ast.AST]]:
+        """(held, acquired, function, site) for every acquisition made
+        while another lock is held — lexically nested ``with`` blocks
+        plus locks inherited from callers via the fixpoint."""
+        held_entry = self.held_on_entry()
+        out: List[Tuple[str, str, FuncInfo, ast.AST]] = []
+        for fid in sorted(self.functions):
+            finfo = self.functions[fid]
+            entry = held_entry.get(fid, set())
+            for lock, node in self.acquisition_sites(finfo):
+                held = set(self.lexical_locks(finfo, node)) | entry
+                for h in sorted(held):
+                    if h != lock:
+                        out.append((h, lock, finfo, node))
+        return out
+
+
+# --------------------------------------------------------------------------
+# Builder
+# --------------------------------------------------------------------------
+
+class _FileScope:
+    """Per-file name environment: module functions, classes, imports."""
+
+    def __init__(self, ctx: Any) -> None:
+        self.ctx = ctx
+        self.module_funcs: Dict[str, FuncInfo] = {}
+        self.imported: Dict[str, str] = {}  # local name -> original name
+
+
+def build(contexts: Sequence[Any]) -> CallGraph:
+    """Build the graph from engine.FileContext objects (their trees are
+    already scope/parent annotated)."""
+    g = CallGraph()
+    scopes: List[_FileScope] = []
+
+    # pass 1: declare functions, classes, lock attrs, imports
+    for ctx in contexts:
+        scope = _FileScope(ctx)
+        scopes.append(scope)
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                for alias in stmt.names:
+                    scope.imported[alias.asname or alias.name.split(".")[0]] \
+                        = alias.name
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = _declare(g, ctx, stmt, None)
+                scope.module_funcs[stmt.name] = fi
+            elif isinstance(stmt, ast.ClassDef):
+                ci = ClassInfo(
+                    name=stmt.name, relpath=ctx.relpath, node=stmt,
+                    bases=[b for b in map(_dotted, stmt.bases) if b],
+                    methods={})
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        ci.methods[sub.name] = _declare(g, ctx, sub,
+                                                        stmt.name)
+                if stmt.name in g.classes or stmt.name in g._ambiguous_classes:
+                    g._ambiguous_classes.add(stmt.name)
+                    g.classes.pop(stmt.name, None)
+                else:
+                    g.classes[stmt.name] = ci
+        # lock attrs + constructor attr types need the class table, done
+        # in pass 2 — but lock attrs only need THIS class, do them now
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.ClassDef) and stmt.name in g.classes:
+                _collect_class_attrs(g.classes[stmt.name])
+
+    # pass 2: attr types (needs the global class table), then edges/entries
+    for scope in scopes:
+        for stmt in scope.ctx.tree.body:
+            if isinstance(stmt, ast.ClassDef) and stmt.name in g.classes:
+                _collect_attr_types(g, g.classes[stmt.name])
+    for scope in scopes:
+        for fid in sorted(g.functions):
+            fi = g.functions[fid]
+            if fi.relpath == scope.ctx.relpath:
+                _extract(g, scope, fi)
+    return g
+
+
+def _declare(g: CallGraph, ctx: Any, node: ast.AST,
+             class_name: Optional[str]) -> FuncInfo:
+    qual = f"{class_name}.{node.name}" if class_name else node.name
+    fid = f"{ctx.relpath}::{qual}"
+    fi = FuncInfo(fid=fid, relpath=ctx.relpath, qualname=qual,
+                  name=node.name, class_name=class_name, node=node, ctx=ctx)
+    g.functions[fid] = fi
+    g.edges.setdefault(fid, [])
+    return fi
+
+
+def _collect_class_attrs(ci: ClassInfo) -> None:
+    for fi in ci.methods.values():
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            vd = _dotted(node.value.func)
+            if vd is None:
+                continue
+            factory = vd.rsplit(".", 1)[-1]
+            if factory not in LOCK_FACTORIES:
+                continue
+            ci.lock_attrs[tgt.attr] = factory
+            if factory in _CONDITION_FACTORIES:
+                # Condition(self._other) shares _other's mutex; a bare
+                # Condition() owns its own (aliases to itself)
+                under = tgt.attr
+                for arg in node.value.args:
+                    if (isinstance(arg, ast.Attribute)
+                            and isinstance(arg.value, ast.Name)
+                            and arg.value.id == "self"):
+                        under = arg.attr
+                ci.cond_underlying[tgt.attr] = under
+
+
+def _collect_attr_types(g: CallGraph, ci: ClassInfo) -> None:
+    for fi in ci.methods.values():
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            if isinstance(node.value, ast.Call):
+                vd = _dotted(node.value.func)
+                cls = vd.rsplit(".", 1)[-1] if vd else None
+                if cls in g.classes:
+                    ci.attr_types[tgt.attr] = cls
+
+
+def _method_in_hierarchy(g: CallGraph, class_name: str,
+                         meth: str, depth: int = 0) -> Optional[FuncInfo]:
+    ci = g.classes.get(class_name)
+    if ci is None or depth > 8:
+        return None
+    if meth in ci.methods:
+        return ci.methods[meth]
+    for base in ci.bases:
+        found = _method_in_hierarchy(g, base.rsplit(".", 1)[-1], meth,
+                                     depth + 1)
+        if found is not None:
+            return found
+    return None
+
+
+def _subclasses_thread(g: CallGraph, ci: ClassInfo, depth: int = 0) -> bool:
+    if depth > 8:
+        return False
+    for base in ci.bases:
+        leaf = base.rsplit(".", 1)[-1]
+        if leaf == "Thread":
+            return True
+        bci = g.classes.get(leaf)
+        if bci is not None and _subclasses_thread(g, bci, depth + 1):
+            return True
+    return False
+
+
+def _is_handler_class(g: CallGraph, ci: ClassInfo, depth: int = 0) -> bool:
+    if depth > 8:
+        return False
+    for base in ci.bases:
+        leaf = base.rsplit(".", 1)[-1]
+        if any(f in leaf for f in _HANDLER_BASE_FRAGMENTS):
+            return True
+        bci = g.classes.get(leaf)
+        if bci is not None and _is_handler_class(g, bci, depth + 1):
+            return True
+    return False
+
+
+class _Extractor:
+    """Resolve call edges + entry registrations inside one function."""
+
+    def __init__(self, g: CallGraph, scope: _FileScope, fi: FuncInfo) -> None:
+        self.g = g
+        self.scope = scope
+        self.fi = fi
+        self.local_types: Dict[str, str] = {}  # var -> class name
+        self._collect_local_types()
+
+    def _collect_local_types(self) -> None:
+        ci = (self.g.classes.get(self.fi.class_name)
+              if self.fi.class_name else None)
+        for node in ast.walk(self.fi.node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            var = node.targets[0].id
+            if isinstance(node.value, ast.Call):
+                vd = _dotted(node.value.func)
+                cls = vd.rsplit(".", 1)[-1] if vd else None
+                if cls in self.g.classes:
+                    self.local_types[var] = cls
+            elif (ci is not None and isinstance(node.value, ast.Attribute)
+                    and isinstance(node.value.value, ast.Name)
+                    and node.value.value.id == "self"
+                    and node.value.attr in ci.attr_types):
+                self.local_types[var] = ci.attr_types[node.value.attr]
+
+    # reference resolution: a Name/Attribute in NON-call position that
+    # denotes a function or method of the package
+    def resolve_ref(self, expr: ast.AST) -> Optional[FuncInfo]:
+        if isinstance(expr, ast.Name):
+            fi = self.scope.module_funcs.get(expr.id)
+            if fi is not None:
+                return fi
+            orig = self.scope.imported.get(expr.id)
+            if orig is not None:
+                cands = [f for f in self.g.functions.values()
+                         if f.class_name is None
+                         and f.name == orig.rsplit(".", 1)[-1]]
+                if len(cands) == 1:
+                    return cands[0]
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and self.fi.class_name:
+                    return _method_in_hierarchy(self.g, self.fi.class_name,
+                                                expr.attr)
+                cls = self.local_types.get(base.id)
+                if cls is not None:
+                    return _method_in_hierarchy(self.g, cls, expr.attr)
+                if base.id in self.g.classes:  # ClassName.method ref
+                    return _method_in_hierarchy(self.g, base.id, expr.attr)
+            elif (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self" and self.fi.class_name):
+                ci = self.g.classes.get(self.fi.class_name)
+                if ci is not None:
+                    cls = ci.attr_types.get(base.attr)
+                    if cls is not None:
+                        return _method_in_hierarchy(self.g, cls, expr.attr)
+        return None
+
+    def resolve_call(self, call: ast.Call) -> Optional[FuncInfo]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            direct = self.resolve_ref(func)
+            if direct is not None:
+                return direct
+            if func.id in self.g.classes:  # ClassName(...) -> __init__
+                return _method_in_hierarchy(self.g, func.id, "__init__")
+            orig = self.scope.imported.get(func.id)
+            if orig is not None:
+                leaf = orig.rsplit(".", 1)[-1]
+                if leaf in self.g.classes:
+                    return _method_in_hierarchy(self.g, leaf, "__init__")
+            return None
+        return self.resolve_ref(func)
+
+    def run(self) -> None:
+        g, fi = self.g, self.fi
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            leaf = dotted.rsplit(".", 1)[-1]
+            in_clo = g.in_closure(node, fi)
+
+            # thread spawn: threading.Thread(target=...)
+            if leaf == "Thread" and leaf not in g.classes:
+                tgt = next((kw.value for kw in node.keywords
+                            if kw.arg == "target"), None)
+                ref = self.resolve_ref(tgt) if tgt is not None else None
+                if ref is not None:
+                    g.entry_labels.setdefault(ref.fid, set()).add(
+                        f"thread:{ref.qualname}")
+                continue
+            # atexit.register(f) / signal.signal(sig, f)
+            if dotted in ("atexit.register", "signal.signal"):
+                kind = dotted.split(".", 1)[0]
+                for arg in node.args:
+                    ref = self.resolve_ref(arg)
+                    if ref is not None:
+                        g.entry_labels.setdefault(ref.fid, set()).add(
+                            f"{kind}:{ref.qualname}")
+                continue
+
+            callee = self.resolve_call(node)
+            if callee is not None:
+                site = CallSite(caller=fi.fid, callee=callee.fid,
+                                node=node, in_closure=in_clo)
+                g.edges[fi.fid].append(site)
+                g.callers.setdefault(callee.fid, []).append(site)
+
+            # escaping callbacks via on_*=/callback= keywords
+            for kw in node.keywords:
+                if kw.arg and (kw.arg.startswith("on_")
+                               or kw.arg in _CALLBACK_KWARG_NAMES):
+                    ref = self.resolve_ref(kw.value)
+                    if ref is not None:
+                        g.entry_labels.setdefault(ref.fid, set()).add(
+                            f"callback:{fi.qualname}")
+
+        # escaping callbacks via ``obj.attr = <method ref>`` (but NOT
+        # ``self.x = self.y`` aliasing inside the same object's init)
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)):
+                continue
+            ref = self.resolve_ref(node.value)
+            if ref is None:
+                continue
+            tgt = node.targets[0]
+            same_self = (isinstance(tgt.value, ast.Name)
+                         and tgt.value.id == "self"
+                         and isinstance(node.value, ast.Attribute)
+                         and isinstance(node.value.value, ast.Name)
+                         and node.value.value.id == "self")
+            if not same_self:
+                self.g.entry_labels.setdefault(ref.fid, set()).add(
+                    f"callback:{fi.qualname}")
+
+
+def _extract(g: CallGraph, scope: _FileScope, fi: FuncInfo) -> None:
+    _Extractor(g, scope, fi).run()
+
+    # Thread subclass run() + request-handler entry methods
+    if fi.class_name is not None:
+        ci = g.classes.get(fi.class_name)
+        if ci is not None:
+            if fi.name == "run" and _subclasses_thread(g, ci):
+                g.entry_labels.setdefault(fi.fid, set()).add(
+                    f"thread:{fi.qualname}")
+            if ((fi.name.startswith("do_") or fi.name == "handle")
+                    and _is_handler_class(g, ci)):
+                g.entry_labels.setdefault(fi.fid, set()).add(
+                    f"handler:{fi.qualname}")

@@ -5,7 +5,9 @@
     python -m tools.tslint --baseline tools/tslint/baseline.json
     python -m tools.tslint --write-baseline         # regenerate baseline
     python -m tools.tslint --format json
-    python -m tools.tslint --select TS003,TS005
+    python -m tools.tslint --rules TS007,TS008    # concurrency subset
+    python -m tools.tslint --changed origin/main  # only changed files
+    python -m tools.tslint --lock-graph /tmp/lockgraph.json
     python -m tools.tslint --list-rules
 
 Exit codes: 0 clean (every finding baselined/suppressed), 1 new
@@ -18,6 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -46,24 +49,91 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--select", default=None,
                    help="comma-separated rule subset, e.g. TS003,TS005")
+    p.add_argument("--rules", default=None, dest="rules",
+                   help="alias of --select (combined when both given)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="BASE",
+                   help="analyze only files changed vs BASE (git diff "
+                        "--name-only BASE, plus untracked; default HEAD). "
+                        "NOTE: the interprocedural rules then see only "
+                        "the changed subset — the full-tree gate stays "
+                        "in scripts/lint.sh")
+    p.add_argument("--lock-graph", default=None, metavar="OUT",
+                   help="write the statically derived lock-order graph "
+                        "as JSON (for TS_LOCKSAN_GRAPH) and exit")
     p.add_argument("--list-rules", action="store_true")
     return p
+
+
+def _changed_files(root: str, base: str, scan_paths: List[str]) -> List[str]:
+    """Root-relative .py files changed vs `base` (committed, staged, or
+    worktree) plus untracked ones, restricted to the requested paths."""
+    out: set = set()
+    for cmd in (["git", "diff", "--name-only", base],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(cmd, cwd=root, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd)}: {proc.stderr.strip() or 'failed'}")
+        out.update(l.strip() for l in proc.stdout.splitlines() if l.strip())
+    prefixes = [os.path.normpath(p).replace(os.sep, "/")
+                for p in scan_paths]
+    selected = []
+    for rel in sorted(out):
+        if not rel.endswith(".py"):
+            continue
+        if not os.path.exists(os.path.join(root, rel)):
+            continue  # deleted in the diff — nothing to analyze
+        if any(p in (".", rel) or rel.startswith(p + "/")
+               for p in prefixes):
+            selected.append(rel)
+    return selected
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.list_rules:
-        from tools.tslint.rules import RULES
+        from tools.tslint import ALL_RULES
 
-        for r in RULES:
-            print(f"{r.id}  {r.name:<22} {r.summary}")
+        for r in ALL_RULES:
+            print(f"{r.id}  {r.name:<28} {r.summary}")
         return 0
 
     root = os.path.abspath(args.root or os.getcwd())
-    select = ({s.strip().upper() for s in args.select.split(",") if s.strip()}
-              if args.select else None)
+    spec = ",".join(s for s in (args.select, args.rules) if s)
+    select = ({s.strip().upper() for s in spec.split(",") if s.strip()}
+              if spec else None)
+
+    if args.lock_graph:
+        try:
+            payload = engine.lock_graph(args.paths, root=root)
+        except FileNotFoundError as e:
+            print(f"tslint: {e}", file=sys.stderr)
+            return 2
+        out = args.lock_graph
+        if not os.path.isabs(out):
+            out = os.path.join(root, out)
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+        print(f"tslint: wrote {len(payload['edges'])} lock-order edge(s) "
+              f"over {len(payload['locks'])} lock(s) to {args.lock_graph}")
+        return 0
+
+    scan_paths = list(args.paths)
+    if args.changed is not None:
+        try:
+            scan_paths = _changed_files(root, args.changed, scan_paths)
+        except (OSError, RuntimeError) as e:
+            print(f"tslint: --changed: {e}", file=sys.stderr)
+            return 2
+        if not scan_paths:
+            print("tslint: no changed python files under "
+                  f"{' '.join(args.paths)} vs {args.changed}")
+            return 0
+
     try:
-        result = engine.analyze(args.paths, root=root, select=select)
+        result = engine.analyze(scan_paths, root=root, select=select)
     except FileNotFoundError as e:
         print(f"tslint: {e}", file=sys.stderr)
         return 2
@@ -87,9 +157,34 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.write_baseline:
         out = baseline_path or os.path.join(root, DEFAULT_BASELINE)
-        engine.write_baseline(result.findings, out)
-        print(f"tslint: wrote {len(result.findings)} finding(s) to "
-              f"{os.path.relpath(out, root)}")
+        # merge semantics: entries for files this scan did not visit are
+        # carried forward (a --changed subset run must not clobber the
+        # rest of the tree's debt), entries for deleted files are pruned
+        extra: list = []
+        pruned = 0
+        if os.path.exists(out):
+            try:
+                old = engine.load_baseline(out)
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"tslint: bad baseline {out}: {e}", file=sys.stderr)
+                return 2
+            scanned = set(result.paths_scanned)
+            for e in old.get("findings", ()):
+                p = e.get("path", "")
+                if p in scanned:
+                    continue  # replaced by this scan's findings
+                if not os.path.exists(os.path.join(root, p)):
+                    pruned += 1
+                    continue  # the file is gone — stale debt
+                extra.append(e)
+        engine.write_baseline(result.findings, out, extra_entries=extra)
+        msg = (f"tslint: wrote {len(result.findings)} finding(s) to "
+               f"{os.path.relpath(out, root)}")
+        if extra:
+            msg += f" (+{len(extra)} carried from unscanned files)"
+        if pruned:
+            msg += f" ({pruned} deleted-file entr{'y' if pruned == 1 else 'ies'} pruned)"
+        print(msg)
         return 0
 
     baselined = 0
@@ -104,7 +199,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
         new, baselined, stale = engine.match_baseline(result.findings,
-                                                      baseline)
+                                                      baseline, select)
 
     if args.format == "json":
         print(json.dumps({

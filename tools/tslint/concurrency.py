@@ -1,0 +1,414 @@
+"""TS007–TS010: interprocedural concurrency rules over the call graph.
+
+These are *project* rules — they run once over every parsed file after
+the per-file rules, riding tools/tslint/callgraph.py.  Findings are
+reported through the owning FileContext, so inline ``# tslint:
+disable=...`` suppressions and the fingerprint baseline work unchanged.
+
+TS007 lock-order-cycle
+    Build the lock acquisition-order graph: an edge A -> B for every
+    site that acquires B while A is held (lexically nested ``with``
+    blocks, plus locks inherited from callers through the held-on-entry
+    fixpoint).  Any cycle is a deadlock risk: two threads entering the
+    cycle from different points block each other forever.
+
+TS008 blocking-under-lock
+    A blocking primitive (socket connect/recv, subprocess wait/
+    communicate, urlopen, time.sleep, event waits) — or a call that
+    transitively reaches one — inside a ``with self._lock:`` region
+    stalls every thread contending on that lock for the primitive's
+    full latency (the procfleet scrape path is the motivating shape:
+    a wedged child must cost the scraper a timeout, never the router).
+    ``cond.wait()`` on a condition whose underlying mutex is the held
+    lock is exempt — that wait *releases* the lock by contract.
+
+TS009 cross-thread-unlocked-write
+    An instance attribute written (outside ``__init__``) from methods
+    whose inferred thread roots differ — supervisor thread vs router
+    tick vs stored callback — where at least one write is outside any
+    lock region, is a data race.
+
+TS010 future-single-resolution
+    Settle-state discipline for future-like classes: a class with a
+    ``_finish``-style funnel must write its settle attrs (and fire its
+    done-event) ONLY inside the funnel; a class with a ``_settled``
+    guard flag must write that flag in every method that resolves or
+    rejects a member future.  Exactly-once resolution is what the
+    router's first-wins hedging and kill-requeue paths stand on.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tools.tslint import callgraph
+from tools.tslint.rules import Rule
+
+
+class ProjectContext:
+    """All FileContexts plus the built call graph."""
+
+    def __init__(self, contexts: List[Any], graph: callgraph.CallGraph,
+                 config: Dict[str, Any]) -> None:
+        self.contexts = {c.relpath: c for c in contexts}
+        self.graph = graph
+        self.config = config
+
+    def rule_config(self, rule_id: str) -> Dict[str, Any]:
+        return self.config.get("rules", {}).get(rule_id, {})
+
+    def report(self, rule: str, relpath: str, node: Optional[ast.AST],
+               message: str) -> None:
+        ctx = self.contexts.get(relpath)
+        if ctx is not None:
+            ctx.report(rule, node, message)
+
+
+# --------------------------------------------------------------------------
+# TS007: lock-order cycles
+# --------------------------------------------------------------------------
+
+def _sccs(nodes: Set[str], adj: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan strongly-connected components, iterative."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    for start in sorted(nodes):
+        if start in index:
+            continue
+        work: List[Tuple[str, int]] = [(start, 0)]
+        while work:
+            v, pi = work.pop()
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack.add(v)
+            recurse = False
+            succs = sorted(adj.get(v, ()))
+            for i in range(pi, len(succs)):
+                w = succs[i]
+                if w not in index:
+                    work.append((v, i + 1))
+                    work.append((w, 0))
+                    recurse = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if recurse:
+                continue
+            if low[v] == index[v]:
+                scc: Set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == v:
+                        break
+                out.append(scc)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+    return out
+
+
+def check_ts007(pctx: ProjectContext) -> None:
+    g = pctx.graph
+    edges = g.lock_order_edges()
+    adj: Dict[str, Set[str]] = {}
+    nodes: Set[str] = set()
+    for held, acq, _, _ in edges:
+        adj.setdefault(held, set()).add(acq)
+        nodes.add(held)
+        nodes.add(acq)
+    cyclic: Set[frozenset] = set()
+    for scc in _sccs(nodes, adj):
+        if len(scc) > 1:
+            cyclic.add(frozenset(scc))
+    if not cyclic:
+        return
+    seen: Set[Tuple[str, str]] = set()
+    for held, acq, finfo, node in edges:
+        scc = next((s for s in cyclic if held in s and acq in s), None)
+        if scc is None or (held, acq) in seen:
+            continue
+        seen.add((held, acq))
+        members = " <-> ".join(sorted(scc))
+        pctx.report(
+            "TS007", finfo.relpath, node,
+            f"lock-order cycle: acquires {acq} while holding {held}, but "
+            f"the reverse order also occurs ({members}) — two threads "
+            f"entering from opposite ends deadlock")
+
+
+# --------------------------------------------------------------------------
+# TS008: blocking call while a lock is held
+# --------------------------------------------------------------------------
+
+def _blocking_primitives(pctx: ProjectContext, finfo: callgraph.FuncInfo,
+                         ) -> List[Tuple[ast.AST, str]]:
+    cfg = pctx.rule_config("TS008")
+    roots = tuple(cfg.get("blocking_roots", ()))
+    methods = set(cfg.get("blocking_methods", ()))
+    out: List[Tuple[ast.AST, str]] = []
+    for node in ast.walk(finfo.node):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = callgraph._dotted(node.func)
+        if dotted is not None and dotted in roots:
+            out.append((node, dotted))
+            continue
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in methods):
+            out.append((node, f".{node.func.attr}()"))
+    return out
+
+
+def _wait_exempt(g: callgraph.CallGraph, finfo: callgraph.FuncInfo,
+                 node: ast.AST, held: List[str]) -> bool:
+    """``self._cv.wait()`` releases _cv's underlying mutex — waiting on
+    a condition whose mutex is the held lock is the sanctioned pattern,
+    not a stall."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("wait", "wait_for")):
+        return False
+    lid = g._lock_of_expr(node.func.value, finfo)
+    return lid is not None and lid in held
+
+
+def check_ts008(pctx: ProjectContext) -> None:
+    g = pctx.graph
+    # transitive "does this function block, and through what" map
+    blocking: Dict[str, str] = {}
+    for fid in sorted(g.functions):
+        prims = _blocking_primitives(pctx, g.functions[fid])
+        if prims:
+            blocking[fid] = prims[0][1]
+    changed = True
+    while changed:
+        changed = False
+        for fid in sorted(g.functions):
+            if fid in blocking:
+                continue
+            for site in g.edges.get(fid, ()):
+                label = blocking.get(site.callee)
+                if label is not None:
+                    callee = g.functions[site.callee].qualname
+                    blocking[fid] = f"{callee} -> {label}"
+                    changed = True
+                    break
+
+    for fid in sorted(g.functions):
+        finfo = g.functions[fid]
+        reported: Set[int] = set()
+        for node, label in _blocking_primitives(pctx, finfo):
+            held = g.lexical_locks(finfo, node)
+            if not held or _wait_exempt(g, finfo, node, held):
+                continue
+            line = getattr(node, "lineno", 0)
+            if line in reported:
+                continue
+            reported.add(line)
+            pctx.report(
+                "TS008", finfo.relpath, node,
+                f"blocking call {label} while holding "
+                f"{', '.join(held)} — every thread contending on the "
+                f"lock stalls for the call's full latency")
+        for site in g.edges.get(fid, ()):
+            label = blocking.get(site.callee)
+            if label is None:
+                continue
+            held = g.lexical_locks(finfo, site.node)
+            if not held:
+                continue
+            line = getattr(site.node, "lineno", 0)
+            if line in reported:
+                continue
+            reported.add(line)
+            callee = g.functions[site.callee].qualname
+            pctx.report(
+                "TS008", finfo.relpath, site.node,
+                f"call to {callee} (blocks via {label}) while holding "
+                f"{', '.join(held)}")
+
+
+# --------------------------------------------------------------------------
+# TS009: cross-thread writes outside any lock
+# --------------------------------------------------------------------------
+
+def _self_attr_writes(finfo: callgraph.FuncInfo) -> List[Tuple[str, ast.AST]]:
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(finfo.node):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Tuple):
+                targets.extend(tgt.elts)
+                continue
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                out.append((tgt.attr, node))
+    return out
+
+
+def check_ts009(pctx: ProjectContext) -> None:
+    import re as _re
+    g = pctx.graph
+    held_entry = g.held_on_entry()
+    init_re = _re.compile(pctx.rule_config("TS009").get(
+        "init_method_re", r"^(__init__|__new__|__post_init__|_init[a-z_]*)$"))
+    for cname in sorted(g.classes):
+        ci = g.classes[cname]
+        # attr -> [(method, node, protected)]
+        writes: Dict[str, List[Tuple[callgraph.FuncInfo, ast.AST, bool]]] = {}
+        for mname in sorted(ci.methods):
+            if init_re.search(mname):
+                # construction-time writes happen before the object is
+                # shared across threads (happens-before via Thread.start)
+                continue
+            finfo = ci.methods[mname]
+            entry_held = held_entry.get(finfo.fid, set())
+            for attr, node in _self_attr_writes(finfo):
+                if attr in ci.lock_attrs:
+                    continue
+                if g.in_closure(node, finfo):
+                    continue  # a closure writes on its own schedule
+                protected = bool(g.lexical_locks(finfo, node) or entry_held)
+                writes.setdefault(attr, []).append((finfo, node, protected))
+        for attr in sorted(writes):
+            sites = writes[attr]
+            roots: Set[str] = set()
+            for finfo, _, _ in sites:
+                roots |= g.roots(finfo.fid)
+            if len(roots) < 2:
+                continue
+            unlocked = [(f, n) for f, n, prot in sites if not prot]
+            if not unlocked:
+                continue
+            finfo, node = unlocked[0]
+            writers = sorted({f.qualname for f, _, _ in sites})
+            pctx.report(
+                "TS009", finfo.relpath, node,
+                f"self.{attr} is written from {len(roots)} thread roots "
+                f"({', '.join(sorted(roots))}; writers: "
+                f"{', '.join(writers)}) with this write outside any lock "
+                f"— cross-thread data race")
+
+
+# --------------------------------------------------------------------------
+# TS010: future settle paths must funnel through one method
+# --------------------------------------------------------------------------
+
+def check_ts010(pctx: ProjectContext) -> None:
+    g = pctx.graph
+    cfg = pctx.rule_config("TS010")
+    funnels = tuple(cfg.get("funnel_methods", ("_finish",)))
+    flags = tuple(cfg.get("settle_flags", ("_settled",)))
+    resolvers = tuple(cfg.get("resolver_methods",
+                              ("_finish", "_resolve", "_reject")))
+    for cname in sorted(g.classes):
+        ci = g.classes[cname]
+        funnel_name = next((f for f in funnels if f in ci.methods), None)
+
+        # clause A: settle attrs of the funnel are written nowhere else
+        if funnel_name is not None:
+            funnel = ci.methods[funnel_name]
+            state: Set[str] = {a for a, _ in _self_attr_writes(funnel)}
+            for node in ast.walk(funnel.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "set"):
+                    inner = node.func.value
+                    if (isinstance(inner, ast.Attribute)
+                            and isinstance(inner.value, ast.Name)
+                            and inner.value.id == "self"):
+                        state.add(inner.attr)
+            state -= set(ci.lock_attrs)
+            for mname in sorted(ci.methods):
+                if mname in (funnel_name, "__init__", "__new__"):
+                    continue
+                finfo = ci.methods[mname]
+                for attr, node in _self_attr_writes(finfo):
+                    if attr in state:
+                        pctx.report(
+                            "TS010", finfo.relpath, node,
+                            f"settle state self.{attr} written outside the "
+                            f"{cname}.{funnel_name} funnel — double "
+                            f"resolution becomes possible")
+                for node in ast.walk(finfo.node):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "set"):
+                        inner = node.func.value
+                        if (isinstance(inner, ast.Attribute)
+                                and isinstance(inner.value, ast.Name)
+                                and inner.value.id == "self"
+                                and inner.attr in state):
+                            pctx.report(
+                                "TS010", finfo.relpath, node,
+                                f"settle event self.{inner.attr}.set() "
+                                f"fired outside the {cname}.{funnel_name} "
+                                f"funnel — waiters can observe an "
+                                f"unsettled future as done")
+
+        # clause B: any method resolving a member future must write the
+        # class's settle guard flag (first-wins discipline)
+        flag = None
+        for mname, finfo in ci.methods.items():
+            for attr, _ in _self_attr_writes(finfo):
+                if attr in flags:
+                    flag = attr
+                    break
+            if flag:
+                break
+        if flag is None:
+            continue
+        for mname in sorted(ci.methods):
+            if mname in ("__init__", "__new__"):
+                continue
+            finfo = ci.methods[mname]
+            writes_flag = any(a == flag for a, _ in _self_attr_writes(finfo))
+            for node in ast.walk(finfo.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in resolvers):
+                    continue
+                # only member-future resolution (self.<attr>._resolve())
+                recv = node.func.value
+                if not (isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"):
+                    continue
+                if not writes_flag:
+                    pctx.report(
+                        "TS010", finfo.relpath, node,
+                        f"{cname}.{mname} settles self.{recv.attr}."
+                        f"{node.func.attr}() without writing the "
+                        f"self.{flag} guard — a racing settle path can "
+                        f"resolve the future twice")
+
+
+PROJECT_RULES = (
+    Rule("TS007", "lock-order-cycle",
+         "cyclic lock acquisition order across the call graph "
+         "(deadlock risk)", check_ts007),
+    Rule("TS008", "blocking-under-lock",
+         "socket/subprocess/sleep/wait reachable inside a lock region",
+         check_ts008),
+    Rule("TS009", "cross-thread-unlocked-write",
+         "attr written from >=2 inferred thread roots with an unlocked "
+         "write", check_ts009),
+    Rule("TS010", "future-single-resolution",
+         "future settle state must funnel through the one _finish-style "
+         "method", check_ts010),
+)

@@ -150,6 +150,42 @@ DEFAULT: Dict[str, Any] = {
         "TS004": {"enabled": True},
         "TS005": {"enabled": True},
         "TS006": {"enabled": True},
+        # -- interprocedural concurrency rules (callgraph.py) --
+        "TS007": {"enabled": True},
+        "TS008": {
+            "enabled": True,
+            # dotted call roots that block the calling thread outright
+            "blocking_roots": [
+                "time.sleep",
+                "socket.create_connection",
+                "urllib.request.urlopen",
+                "subprocess.run", "subprocess.call",
+                "subprocess.check_call", "subprocess.check_output",
+            ],
+            # attribute-call names that block on sockets / processes /
+            # events; ``cond.wait()`` on the held lock's own condition
+            # is exempted by the rule (it RELEASES that lock)
+            "blocking_methods": [
+                "recv", "recvfrom", "accept", "connect", "connect_ex",
+                "sendall", "communicate", "wait", "urlopen", "sleep",
+            ],
+        },
+        "TS009": {
+            "enabled": True,
+            # writers matching this run at construction time, before the
+            # object escapes to other threads (happens-before via
+            # Thread.start) — they don't count as racing accesses
+            "init_method_re":
+                r"^(__init__|__new__|__post_init__|_init[a-z_]*)$",
+        },
+        "TS010": {
+            "enabled": True,
+            # the single sanctioned settle funnel (clause A) and the
+            # first-wins guard-flag discipline (clause B)
+            "funnel_methods": ["_finish"],
+            "settle_flags": ["_settled"],
+            "resolver_methods": ["_finish", "_resolve", "_reject"],
+        },
     },
 }
 

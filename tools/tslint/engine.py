@@ -177,6 +177,42 @@ class AnalysisResult:
     findings: List[Finding]
     suppressed: int
     files: int
+    #: root-relative paths of every file that was actually parsed and
+    #: analyzed (``--write-baseline`` merge semantics key on this)
+    paths_scanned: List[str] = dataclasses.field(default_factory=list)
+
+
+def _rule_active(rule_id: str, cfg: Dict[str, Any],
+                 select: Optional[Set[str]]) -> bool:
+    if select is not None and rule_id not in select:
+        return False
+    return bool(cfg.get("rules", {}).get(rule_id, {}).get("enabled", True))
+
+
+def parse_files(paths: Sequence[str], root: str, cfg: Dict[str, Any],
+                ) -> Tuple[List["FileContext"], List[Finding], List[str]]:
+    """Parse every .py under `paths` into FileContexts; syntax errors
+    become TS000 findings.  Returns (contexts, parse_findings, relpaths
+    scanned — including the unparseable ones)."""
+    exclude = set(cfg.get("exclude_dirs", ()))
+    contexts: List[FileContext] = []
+    parse_findings: List[Finding] = []
+    scanned: List[str] = []
+    for abspath in _iter_py_files(paths, root, exclude):
+        relpath = os.path.relpath(abspath, root).replace(os.sep, "/")
+        scanned.append(relpath)
+        with open(abspath, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError as e:
+            parse_findings.append(Finding(
+                PARSE_RULE, relpath, e.lineno or 1, e.offset or 0,
+                f"file does not parse: {e.msg}", "<module>",
+                (e.text or "").strip()))
+            continue
+        contexts.append(FileContext(relpath, source, tree, cfg))
+    return contexts, parse_findings, scanned
 
 
 def analyze(paths: Sequence[str], root: Optional[str] = None,
@@ -184,40 +220,56 @@ def analyze(paths: Sequence[str], root: Optional[str] = None,
             select: Optional[Set[str]] = None) -> AnalysisResult:
     """Run every enabled rule over `paths` (files or directories,
     resolved against `root`, default cwd).  `select` restricts to a rule
-    subset; `config` is deep-merged over tools.tslint.config.DEFAULT."""
+    subset; `config` is deep-merged over tools.tslint.config.DEFAULT.
+
+    Two passes: the per-file rules (TS001–TS006) see one FileContext at
+    a time; the project rules (TS007–TS010) then run once over ALL
+    contexts riding the package-wide call graph (callgraph.py)."""
     from tools.tslint import rules as rules_mod
 
     root = os.path.abspath(root or os.getcwd())
     cfg = merge_config(config)
-    exclude = set(cfg.get("exclude_dirs", ()))
-    findings: List[Finding] = []
-    suppressed = 0
-    nfiles = 0
-    for abspath in _iter_py_files(paths, root, exclude):
-        relpath = os.path.relpath(abspath, root).replace(os.sep, "/")
-        nfiles += 1
-        with open(abspath, "r", encoding="utf-8") as f:
-            source = f.read()
-        try:
-            tree = ast.parse(source, filename=relpath)
-        except SyntaxError as e:
-            findings.append(Finding(
-                PARSE_RULE, relpath, e.lineno or 1, e.offset or 0,
-                f"file does not parse: {e.msg}", "<module>",
-                (e.text or "").strip()))
-            continue
-        ctx = FileContext(relpath, source, tree, cfg)
+    contexts, findings, scanned = parse_files(paths, root, cfg)
+    for ctx in contexts:
         for rule in rules_mod.RULES:
-            if select is not None and rule.id not in select:
-                continue
-            if not cfg.get("rules", {}).get(rule.id, {}).get("enabled", True):
-                continue
-            rule.check(ctx)
+            if _rule_active(rule.id, cfg, select):
+                rule.check(ctx)
+
+    from tools.tslint import concurrency
+    project_rules = [r for r in concurrency.PROJECT_RULES
+                     if _rule_active(r.id, cfg, select)]
+    if project_rules and contexts:
+        from tools.tslint import callgraph
+        graph = callgraph.build(contexts)
+        pctx = concurrency.ProjectContext(contexts, graph, cfg)
+        for rule in project_rules:
+            rule.check(pctx)
+
+    suppressed = 0
+    for ctx in contexts:
         findings.extend(ctx.findings)
         suppressed += ctx.suppressed
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return AnalysisResult(findings=findings, suppressed=suppressed,
-                          files=nfiles)
+                          files=len(scanned), paths_scanned=scanned)
+
+
+def lock_graph(paths: Sequence[str], root: Optional[str] = None,
+               config: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Statically derived lock-order graph for the runtime sanitizer
+    (obs/locksan.py cross-checks real acquisition order against these
+    edges when ``TS_LOCKSAN_GRAPH`` points at the exported JSON)."""
+    root = os.path.abspath(root or os.getcwd())
+    cfg = merge_config(config)
+    contexts, _, _ = parse_files(paths, root, cfg)
+    from tools.tslint import callgraph
+    graph = callgraph.build(contexts)
+    edges = sorted({(a, b) for a, b, _, _ in graph.lock_order_edges()})
+    locks = sorted({f"{c}.{ci.cond_underlying.get(attr, attr)}"
+                    for c, ci in graph.classes.items()
+                    for attr in ci.lock_attrs})
+    return {"version": 1, "tool": "tslint",
+            "locks": locks, "edges": [list(e) for e in edges]}
 
 
 # --------------------------------------------------------------------------
@@ -232,7 +284,11 @@ def load_baseline(path: str) -> Dict[str, Any]:
     return data
 
 
-def write_baseline(findings: Sequence[Finding], path: str) -> None:
+def write_baseline(findings: Sequence[Finding], path: str,
+                   extra_entries: Sequence[Dict[str, Any]] = ()) -> None:
+    """`extra_entries` carries forward raw baseline entries for files a
+    subset scan (``--changed``) did not visit — already pruned of
+    deleted files by the caller."""
     entries = [{
         "fingerprint": f.fingerprint,
         "rule": f.rule,
@@ -242,6 +298,9 @@ def write_baseline(findings: Sequence[Finding], path: str) -> None:
         "message": f.message,
         "line": f.line,  # informational only — matching is by fingerprint
     } for f in findings]
+    entries.extend(extra_entries)
+    entries.sort(key=lambda e: (e.get("path", ""), e.get("line", 0),
+                                e.get("rule", "")))
     payload = {"version": 1, "tool": "tslint", "findings": entries}
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w", encoding="utf-8") as f:
@@ -250,13 +309,20 @@ def write_baseline(findings: Sequence[Finding], path: str) -> None:
 
 
 def match_baseline(findings: Sequence[Finding], baseline: Dict[str, Any],
+                   select: Optional[Set[str]] = None,
                    ) -> Tuple[List[Finding], int, List[Dict[str, Any]]]:
     """Split findings into (new, baselined_count, stale_entries).
     Matching is a multiset over fingerprints: N identical grandfathered
     findings absorb at most N live ones; entries no live finding matched
-    are reported stale so the baseline shrinks as debt is paid."""
+    are reported stale so the baseline shrinks as debt is paid.  With
+    `select`, entries for rules OUTSIDE the selected subset are ignored
+    entirely — a filtered run (--rules TS007,TS008) can neither match
+    nor stale-flag the other rules' grandfathered debt."""
+    entries = [e for e in baseline.get("findings", ())
+               if select is None or e.get("rule") in select]
+    baseline = {"findings": entries}
     counts: collections.Counter = collections.Counter(
-        e["fingerprint"] for e in baseline.get("findings", ()))
+        e["fingerprint"] for e in entries)
     used: collections.Counter = collections.Counter()
     new: List[Finding] = []
     for f in findings:

@@ -329,7 +329,10 @@ def check_ts003(ctx: FileContext) -> None:
 # TS004 — lock discipline
 # --------------------------------------------------------------------------
 
-_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition",
+                   # obs/locksan.py wrappers — sanitized locks must stay
+                   # visible to the lock-discipline rules
+                   "make_lock", "make_rlock", "make_condition")
 _CONTAINER_MUTATORS = {
     "append", "appendleft", "extend", "insert", "pop", "popleft", "popitem",
     "remove", "discard", "clear", "update", "add", "setdefault", "sort",
