@@ -70,8 +70,15 @@ Modes (BENCH_MODE):
                     (coalescing + the summary cache, capacity
                     BENCH_SERVE_CACHE) — the heavy-tailed trending-
                     article workload (SERVING.md "Front door");
-                    fingerprint axis only when non-default.  Every
-                    serve row carries `cache_hit_rate`,
+                    fingerprint axis only when non-default;
+                    `--serve-hier[=N]` (BENCH_SERVE_HIER, with
+                    BENCH_HIER_CHUNKS / BENCH_HIER_APPEND) swaps in
+                    the ISSUE-19 long-document map-reduce workload —
+                    the row carries the fan-out makespan vs a
+                    sequential per-chunk baseline plus the append
+                    pass's cache_hit_rate (SERVING.md "Hierarchical
+                    summarization"); fingerprint axis only when armed.
+                    Every serve row carries `cache_hit_rate`,
                     `coalesced_total`, and `decodes_per_submit` (1.0
                     with the door dark — each submit decodes).
   bytes           — XLA cost-analysis byte accounting for the train
@@ -439,6 +446,17 @@ def _config_fingerprint() -> dict:
             fp["replicas"] = int(os.environ["BENCH_SERVE_REPLICAS"])
             if float(os.environ.get("BENCH_SERVE_HEDGE_MS", "0") or 0):
                 fp["hedge_ms"] = float(os.environ["BENCH_SERVE_HEDGE_MS"])
+        # hierarchical long-document axis (ISSUE 19): the map-reduce
+        # fan-out is a DIFFERENT workload than the request-stream
+        # benches (one parent per document, chunk-tier decodes + one
+        # reduce, an append pass that mostly cache-hits) — hier rows
+        # must never stand in for plain serve rows.  Non-default only,
+        # house convention; the fan-out width rides along because
+        # makespans scale with it.
+        if os.environ.get("BENCH_SERVE_HIER", "").lower() in \
+                ("1", "on", "true", "yes"):
+            fp["hier_chunks"] = int(os.environ.get("BENCH_HIER_CHUNKS",
+                                                   "6"))
     if mode == "decode":
         # while vs scan vs chunked decode loops differ by ~1.4 ms per
         # dynamic iteration on the tunneled backend — never
@@ -1367,6 +1385,145 @@ def bench_input() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_serve_hier() -> None:
+    """--serve-hier: the ISSUE-19 long-document workload — ONE
+    multi-chunk document map-reduced through HierarchicalSummarizer
+    over a live server, against a sequential per-chunk baseline, plus
+    an APPEND re-summarize whose cache-hit rate is the row's dedup
+    evidence.  The headline is the fan-out makespan (parent submit ->
+    HierResult, reduce included); `sequential_ms` is the same chunk
+    set decoded one-at-a-time on the same warm server (distinct
+    articles, so the front door cannot help it)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from textsummarization_on_flink_tpu import obs
+    from textsummarization_on_flink_tpu.config import HParams
+    from textsummarization_on_flink_tpu.data.vocab import Vocab
+    from textsummarization_on_flink_tpu.decode.decoder import (
+        BeamSearchDecoder,
+    )
+    from textsummarization_on_flink_tpu.models import get_family
+    from textsummarization_on_flink_tpu.serve.hiersum import (
+        DocumentSession,
+        HierarchicalSummarizer,
+    )
+    from textsummarization_on_flink_tpu.serve.server import ServingServer
+
+    chunks_n = int(os.environ.get("BENCH_HIER_CHUNKS", "6"))
+    append_n = int(os.environ.get("BENCH_HIER_APPEND", "2"))
+    if chunks_n < 2 or append_n < 1:
+        raise ValueError(
+            f"BENCH_HIER_CHUNKS must be >= 2 and BENCH_HIER_APPEND >= 1, "
+            f"got {chunks_n}/{append_n}")
+    serve_mode = os.environ.get("BENCH_SERVE_MODE", "microbatch")
+    wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "20"))
+    hps = HParams(batch_size=int(os.environ.get("BENCH_BATCH", "4")),
+                  mode="decode", coverage=True,
+                  serve_max_wait_ms=wait_ms, serve_mode=serve_mode,
+                  serve_max_queue=max(256, 2 * chunks_n),
+                  serve_coalesce=True, serve_cache_entries=256,
+                  **_preset_overrides())
+    hps.validate()
+    if hps.model_family == "transformer":
+        hps = hps.replace(coverage=False)
+    # full-width chunks (hier_chunk_words=0 -> max_enc_steps): every
+    # chunk runs the same encoder shape, so sequential-vs-fan-out is a
+    # scheduling comparison, not a padding artifact
+    cw = hps.max_enc_steps
+    n_words = max(hps.vocab_size - 4, 100)
+    vocab = Vocab(words=[f"w{i}" for i in range(n_words)])
+    pool = [f"w{i}" for i in range(min(n_words, 2000))]
+
+    def words(start: int, count: int) -> str:
+        # deterministic distinct-ish streams: doc A, doc B (the
+        # sequential baseline), and the appended tail never share a
+        # chunk, so the cache only ever helps the APPEND pass
+        return " ".join(pool[(start + i) % len(pool)]
+                        for i in range(count))
+
+    doc = words(0, chunks_n * cw)
+    seq_chunks = [words(7 + (chunks_n + i) * cw, cw)
+                  for i in range(chunks_n)]
+    tail = words(3 + 2 * chunks_n * cw, append_n * cw)
+    family = get_family(hps.model_family)
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(0))
+    params = _stop_biased(params, hps.vocab_size,
+                          float(os.environ.get("BENCH_STOP_BIAS", "6.0")))
+    tmp = tempfile.mkdtemp(prefix="bench_serve_hier_")
+    try:
+        decoder = BeamSearchDecoder(hps, vocab, batcher=None,
+                                    params=params, decode_root=tmp)
+        server = ServingServer(hps, vocab, decoder=decoder)
+        reg = obs.registry()
+        hs = HierarchicalSummarizer(server, hps)
+        with server:
+            # compile both tiers the workload uses (chunk tier +
+            # reduce tier) before any timed phase
+            server.submit(words(11, cw), uuid="warm-g",
+                          tier="" if serve_mode == "continuous"
+                          else "greedy").result(timeout=1200)
+            server.submit(words(13, cw), uuid="warm-b").result(timeout=1200)
+
+            t0 = time.perf_counter()
+            for i, chunk in enumerate(seq_chunks):
+                server.submit(chunk, uuid=f"seq{i}", block=True,
+                              tier="" if serve_mode == "continuous"
+                              else "greedy").result(timeout=1200)
+            sequential_s = time.perf_counter() - t0
+
+            sess = DocumentSession("bench-doc", doc)
+            t0 = time.perf_counter()
+            hs.summarize("", session=sess, block=True).result(timeout=1200)
+            fanout_s = time.perf_counter() - t0
+
+            hits0 = reg.counter("serve/hier_chunk_cache_hits_total").value
+            done0 = reg.counter("serve/completed_total").value
+            sess.append(tail)
+            t0 = time.perf_counter()
+            hs.summarize("", session=sess, block=True).result(timeout=1200)
+            append_s = time.perf_counter() - t0
+            hits = reg.counter(
+                "serve/hier_chunk_cache_hits_total").value - hits0
+            append_decodes = reg.counter(
+                "serve/completed_total").value - done0
+        fid = reg.histogram("serve/hier_copy_fidelity")
+        rec = {
+            "metric": "serve_hier_fanout_makespan_ms",
+            "value": round(fanout_s * 1000, 2),
+            "unit": "ms",
+            "vs_baseline": 0.0,  # the reference publishes no serving numbers
+            "serve_mode": serve_mode,
+            "hier_chunks": chunks_n,
+            "chunk_words": cw,
+            "sequential_ms": round(sequential_s * 1000, 2),
+            # < 1.0 == the fan-out beat decoding the chunks one at a
+            # time (the committed virtual-time ceiling lives in
+            # SERVE_SLO.json "hierarchical"; this is the wall-clock
+            # evidence at bench scale)
+            "makespan_ratio": round(fanout_s / sequential_s, 4)
+            if sequential_s else 0.0,
+            "append_ms": round(append_s * 1000, 2),
+            "append_chunks": append_n,
+            # dedup by construction: pre-append chunks / resubmitted
+            # chunks served from the front-door cache on the append pass
+            "append_cache_hit_rate": round(
+                hits / (chunks_n + append_n), 4),
+            "append_decodes": int(append_decodes),
+            "copy_fidelity_mean": round(fid.mean, 4),
+            "wait_ms": wait_ms,
+            "model_family": hps.model_family,
+            "timing": "wall-clock makespan, parent submit -> HierResult "
+                      "(reduce included); sequential = same-width chunks "
+                      "decoded one at a time on the same warm server",
+        }
+        print(json.dumps(rec))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_serve() -> None:
     """BENCH_MODE=serve: concurrent serving end-to-end — submitter
     threads push requests through the ServingServer's admission queue
@@ -1374,7 +1531,13 @@ def bench_serve() -> None:
     tiny-or-reference model; the headline is the p50 END-TO-END latency
     a caller observes (enqueue -> resolved future, queue wait and
     coalescing window included), alongside p99, mean batch fill, and
-    aggregate requests/sec."""
+    aggregate requests/sec.  `--serve-hier` (BENCH_SERVE_HIER=1)
+    swaps in the ISSUE-19 long-document map-reduce workload instead
+    (bench_serve_hier)."""
+    if os.environ.get("BENCH_SERVE_HIER", "").lower() in \
+            ("1", "on", "true", "yes"):
+        bench_serve_hier()
+        return
     import shutil
     import tempfile
     from concurrent.futures import ThreadPoolExecutor
@@ -2092,6 +2255,13 @@ if __name__ == "__main__":
         elif arg.startswith("--serve-zipf="):
             os.environ["BENCH_MODE"] = "serve"
             os.environ["BENCH_SERVE_ZIPF"] = arg.split("=", 1)[1]
+        elif arg == "--serve-hier" or arg.startswith("--serve-hier="):
+            # `--serve-hier[=N]`: the ISSUE-19 long-document map-reduce
+            # workload, N chunks wide (BENCH_HIER_CHUNKS)
+            os.environ["BENCH_MODE"] = "serve"
+            os.environ["BENCH_SERVE_HIER"] = "1"
+            if "=" in arg:
+                os.environ["BENCH_HIER_CHUNKS"] = arg.split("=", 1)[1]
     if os.environ.get("TS_BENCH_CHILD") == "1":
         child_main()
     else:

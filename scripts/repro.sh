@@ -113,6 +113,17 @@ echo "== front-door smoke (coalescing + summary cache on a real model)"
 # scheduling claims live in SERVE_SLO.json front_door, in the suite)
 python scripts/front_door_smoke.py
 
+echo "== hiersum smoke (framed long doc -> map-reduce fan-out -> append dedup)"
+# the ISSUE-19 long-document path end to end on a real tiny model: a
+# multi-chunk document arrives as framed rows through the pipeline
+# stage (transform(hierarchical=True)), fans out chunk-by-chunk over a
+# live ServingServer with one reduce pass, then an APPEND frame-set
+# re-summarizes the grown document with every pre-append chunk served
+# from the front-door cache — only the appended tail + one reduce
+# decode (the committed fan-out makespan and cache-hit floor live in
+# SERVE_SLO.json hierarchical, enforced in the suite above)
+python scripts/hiersum_smoke.py
+
 echo "== speculative-tier smoke (draft init -> spec decode -> exactness)"
 # the ISSUE-10 fast path end to end: AAN draft mapped from the full
 # model's own params, draft-then-verify decode through the decoder's

@@ -179,10 +179,19 @@ def request_timeline(paths, uuid: str) -> dict:
     durations.
 
     Returns {"uuid", "trace_id", "events": [...], "spans": [...],
-    "phases": {...}} — events/spans sorted by ts_us.  Phases (ms):
-    ``queue`` = enqueue->admit, ``resident`` = admit->finish (or
-    ->resolve when no finish event exists, e.g. a queue eviction),
-    ``resolve`` = finish->resolve, ``total`` = enqueue->resolve.
+    "phases": {...}, "children": [...]} — events/spans sorted by ts_us.
+    Phases (ms): ``queue`` = enqueue->admit, ``resident`` =
+    admit->finish (or ->resolve when no finish event exists, e.g. a
+    queue eviction), ``resolve`` = finish->resolve, ``total`` =
+    enqueue->resolve.
+
+    ``children`` (ISSUE 19): when the uuid is a HIERARCHICAL document
+    request (serve/hiersum.py), every chunk and reduce sub-request
+    shares the parent's trace_id and carries a ``hier_chunk`` /
+    ``hier_reduce`` lifecycle event — those sub-requests come back as
+    one entry each (chunk index, bucket, tier, cache_hit, resident ms
+    from the child's own admit->finish window) so the whole fan-out
+    tree reconstructs from one events.jsonl.  Empty for plain requests.
     """
     # pass 1: the uuid's (or exemplar trace_id's) request events (tiny
     # result set).  Buffering the file's spans instead would hold
@@ -263,9 +272,52 @@ def request_timeline(paths, uuid: str) -> dict:
                  if e in first), None)
     if root is not None and "resolve" in first:
         phases["total_ms"] = _ms(root, "resolve")
+    # the hier fan-out tree: a document parent's chunk/reduce
+    # sub-requests ride the SAME trace_id under their own uuids, each
+    # self-identifying with a hier_chunk/hier_reduce event — group the
+    # trace's OTHER uuids and keep exactly those (a hedged or
+    # fleet-routed plain request re-emits under its own uuid and is
+    # never mistaken for a child)
+    children: list = []
+    if trace_ids:
+        by_uuid: dict = defaultdict(list)
+        for path in paths:
+            for r in _iter_jsonl(path):
+                if (r.get("kind") == "request"
+                        and r.get("trace_id") in trace_ids
+                        and r.get("uuid") not in (uuid, None, "")):
+                    by_uuid[r["uuid"]].append(r)
+        for child_uuid, evs in by_uuid.items():
+            evs.sort(key=lambda r: r.get("ts_us", 0))
+            hier = next((r for r in evs if r.get("event")
+                         in ("hier_chunk", "hier_reduce")), None)
+            if hier is None:
+                continue
+            attrs = hier.get("attrs") or {}
+            cfirst: dict = {}
+            for r in evs:
+                cfirst.setdefault(r.get("event"), r.get("ts_us", 0))
+            resident = None
+            if "admit" in cfirst:
+                end = cfirst.get("finish", cfirst.get("resolve"))
+                if end is not None:
+                    resident = round((end - cfirst["admit"]) / 1e3, 3)
+            children.append({
+                "uuid": child_uuid,
+                "kind": ("reduce" if hier.get("event") == "hier_reduce"
+                         else "chunk"),
+                "chunk": attrs.get("chunk"),
+                "bucket": attrs.get("bucket"),
+                "tier": attrs.get("tier"),
+                "cache_hit": bool(attrs.get("cache_hit")),
+                "resident_ms": resident,
+            })
+        children.sort(key=lambda c: (c["kind"] == "reduce",
+                                     c["chunk"] if c["chunk"] is not None
+                                     else 1 << 30, c["uuid"]))
     return {"uuid": uuid, "trace_id": trace_id, "events": events,
             "spans": spans, "phases": phases,
-            "trace_ids": sorted(trace_ids)}
+            "children": children, "trace_ids": sorted(trace_ids)}
 
 
 def print_request_timeline(tl: dict) -> int:
@@ -285,6 +337,25 @@ def print_request_timeline(tl: dict) -> int:
     if tl["phases"]:
         print("phases: " + " | ".join(
             f"{k[:-3]} {v:.3f} ms" for k, v in tl["phases"].items()))
+    if tl.get("children"):
+        kids = tl["children"]
+        n_chunks = sum(1 for c in kids if c["kind"] == "chunk")
+        n_red = len(kids) - n_chunks
+        print(f"fan-out ({n_chunks} chunk{'s' if n_chunks != 1 else ''}"
+              + (f" + {n_red} reduce" if n_red else "") + "):")
+        for i, c in enumerate(kids):
+            branch = "└─" if i == len(kids) - 1 else "├─"
+            label = (f"reduce" if c["kind"] == "reduce"
+                     else f"chunk {c['chunk']}")
+            cost = ("cache hit" if c["cache_hit"]
+                    else (f"resident {c['resident_ms']:.3f} ms"
+                          if c["resident_ms"] is not None else "pending"))
+            detail = ", ".join(
+                x for x in (f"bucket {c['bucket']}"
+                            if c["bucket"] is not None else "",
+                            f"tier {c['tier']}" if c["tier"] else "",
+                            cost) if x)
+            print(f"  {branch} {c['uuid']}  {label}  ({detail})")
     if tl["spans"]:
         print(f"spans in trace ({len(tl['spans'])}):")
         for s in tl["spans"]:

@@ -1187,3 +1187,146 @@ def test_proc_fleet_reply_ring_covers_inflight_capacity(slo):
         f"reply ring ({hub.capacity}) smaller than one replica's "
         f"in-flight capacity ({capacity}) — a reconnect could replay "
         f"past live work")
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical long-document summarization (ISSUE 19; SERVING.md
+# "Hierarchical summarization") — the REAL HierarchicalSummarizer over a
+# REAL continuous ServingServer with the front door armed, costed by the
+# counting sim engine.  Fan-out makespan, the sequential baseline, and
+# the append-path dedup are exact scheduling facts on the virtual clock.
+
+
+def _hier_workload(slo):
+    return {**slo["workload"], **slo["hierarchical"]["workload"]}
+
+
+def _hier_doc(wl):
+    """One doc exactly doc_chunks wide whose words are all DISTINCT
+    (w0, w1, ...): distinct chunk content -> distinct article_key per
+    chunk, so nothing coalesces WITHIN the first pass and the append
+    pins measure the front door's dedup, not accidental twins.  The doc
+    ends exactly on a chunk boundary (len = chunk + (n-1)*stride), so
+    appending leaves every pre-append chunk byte-identical."""
+    stride = wl["chunk_words"] - wl["overlap_words"]
+    n_words = wl["chunk_words"] + (wl["doc_chunks"] - 1) * stride
+    doc = " ".join(f"w{i}" for i in range(n_words))
+    tail = " ".join(f"w{n_words + i}"
+                    for i in range(wl["append_chunks"] * stride))
+    return doc, tail
+
+
+def _run_hier(slo, slots: int, append: bool):
+    """Fan one document through a real continuous server with `slots`
+    slots (slots=1 is the sequential baseline); optionally append and
+    re-summarize on the warm server.  Returns the measured scheduling
+    facts."""
+    from textsummarization_on_flink_tpu.serve.hiersum import (
+        DocumentSession,
+        HierarchicalSummarizer,
+    )
+
+    wl = _hier_workload(slo)
+    vocab = Vocab(words=WORDS)
+    hps = HParams(
+        mode="decode", batch_size=slots, vocab_size=vocab.size(),
+        max_enc_steps=wl["chunk_words"], max_dec_steps=wl["long_steps"],
+        beam_size=2, min_dec_steps=1, max_oov_buckets=4,
+        serve_max_queue=256, serve_mode="continuous", serve_slots=slots,
+        serve_refill_chunk=wl["chunk"], serve_coalesce=True,
+        serve_cache_entries=wl["cache_entries"],
+        hier_chunk_words=wl["chunk_words"],
+        hier_overlap_words=wl["overlap_words"])
+    doc, tail = _hier_doc(wl)
+    out = {}
+    with obs.use_registry(Registry()) as reg:
+        sim = CountingSimEngine({**wl, "slots": slots})
+        server = ServingServer(hps, vocab, decoder=_NullDecoder(),
+                               engine=sim, registry=reg)
+        hs = HierarchicalSummarizer(server, hps, registry=reg)
+        sess = DocumentSession("doc", doc)
+        marks = {}
+        # enqueue the whole fan-out BEFORE the dispatch thread starts
+        # (the committed discipline: slot assignment is pure FIFO)
+        fut = hs.summarize("", session=sess)
+        fut.add_done_callback(lambda f: marks.setdefault("fan", sim.vtime))
+        server.start()
+        res = fut.result(timeout=120)
+        assert res.chunk_count == wl["doc_chunks"]
+        out["fan_makespan"] = marks["fan"]
+        out["fan_decodes"] = sim.pack_count
+        if append:
+            hits0 = reg.counter("serve/cache_hits_total").value
+            packs0 = sim.pack_count
+            t0 = sim.vtime  # idle ticks never step the engine
+            sess.append(tail)
+            fut2 = hs.summarize("", session=sess)
+            fut2.add_done_callback(
+                lambda f: marks.setdefault("app", sim.vtime))
+            res2 = fut2.result(timeout=120)
+            out["append_makespan"] = marks["app"] - t0
+            out["append_hits"] = \
+                reg.counter("serve/cache_hits_total").value - hits0
+            out["append_decodes"] = sim.pack_count - packs0
+            out["append_reused"] = res2.reused_chunks
+            out["append_chunk_count"] = res2.chunk_count
+            out["documents"] = \
+                reg.counter("serve/hier_documents_total").value
+            out["reduces"] = reg.counter("serve/hier_reduce_total").value
+            out["partials"] = \
+                reg.counter("serve/hier_partial_failures_total").value
+        server.stop()
+    return out
+
+
+@pytest.fixture(scope="module")
+def hier_measured(slo):
+    wl = _hier_workload(slo)
+    fan = _run_hier(slo, slots=wl["slots"], append=True)
+    seq = _run_hier(slo, slots=1, append=False)
+    return {"fan": fan, "seq": seq}
+
+
+def test_hier_fanout_makespan_beats_sequential(slo, hier_measured):
+    """The map-reduce win, gated: fanning the document's chunks over
+    the slots must beat decoding them one after another by the
+    committed ratio — and stay under the absolute ceiling."""
+    sec = slo["hierarchical"]
+    fan = hier_measured["fan"]["fan_makespan"]
+    seq = hier_measured["seq"]["fan_makespan"]
+    assert fan <= sec["fanout_makespan_virtual_ms_max"], (
+        f"hier fan-out makespan {fan} vms (committed max "
+        f"{sec['fanout_makespan_virtual_ms_max']}) — chunk scheduling "
+        f"regressed")
+    ratio = fan / seq
+    assert ratio <= sec["fanout_makespan_ratio_max"], (
+        f"hier fan-out makespan {fan} vms vs sequential {seq} (ratio "
+        f"{ratio:.2f}, committed max {sec['fanout_makespan_ratio_max']}) "
+        f"— the fan-out stopped buying parallelism")
+
+
+def test_hier_append_dedups_by_construction(slo, hier_measured):
+    """The append-path floor, pinned EXACTLY: re-summarizing after an
+    append must cache-hit every pre-append chunk at submit and decode
+    only the appended chunks + one reduce — chunk boundaries are a pure
+    function of word index, so this is dedup by construction and any
+    drift is a bug, not noise."""
+    sec = slo["hierarchical"]
+    wl = _hier_workload(slo)
+    m = hier_measured["fan"]
+    assert m["append_hits"] == sec["append_cache_hits_expected"], (
+        f"append pass cache-hit {m['append_hits']} chunks (expected "
+        f"exactly {sec['append_cache_hits_expected']}) — a boundary or "
+        f"key drifted and the front door re-decoded unchanged content")
+    assert m["append_decodes"] == sec["append_decodes_expected"], (
+        f"append pass served {m['append_decodes']} decodes (expected "
+        f"exactly {sec['append_decodes_expected']}: the appended chunks "
+        f"+ one reduce)")
+    assert m["append_reused"] == wl["doc_chunks"]
+    assert m["append_chunk_count"] == \
+        wl["doc_chunks"] + wl["append_chunks"]
+    assert m["append_makespan"] <= sec["append_makespan_virtual_ms_max"]
+    # bookkeeping: two documents, two reduces, zero partial failures
+    assert m["documents"] == 2
+    assert m["reduces"] == 2
+    assert m["partials"] == 0

@@ -421,6 +421,26 @@ class HParams:
     # this old (the router tick runs every ~5 ms; it must not issue N
     # HTTP GETs per tick).  0 = scrape on every read.
     serve_scrape_interval_ms: float = 50.0
+    # ---- hierarchical document summarization (SERVING.md
+    # "Hierarchical summarization"; ISSUE 19) ----
+    # Words per document chunk in the map pass (serve/hiersum.py).
+    # 0 = max_enc_steps (chunk at the full encoder width); explicit
+    # values must fit the encoder (<= max_enc_steps) — a chunk wider
+    # than the horizon would be silently truncated at tokenization and
+    # its article_key would no longer describe what was decoded.
+    hier_chunk_words: int = 0
+    # Words of overlap between adjacent chunks: context carried across
+    # the cut so a sentence split by a boundary is seen whole by one of
+    # its chunks.  Must stay below the chunk width (stride =
+    # chunk - overlap must be >= 1 or chunking cannot advance).
+    hier_overlap_words: int = 0
+    # Quality tier of the per-chunk map decodes ("" = greedy: chunks
+    # are intermediate material, cheap extractive passes suffice) and
+    # of the reduce decode ("" = beam: the caller-visible summary).
+    # On a continuous-mode surface both collapse to beam (the resident
+    # slot state is fixed-beam, server.py submit validation).
+    hier_chunk_tier: str = "greedy"
+    hier_reduce_tier: str = "beam"
     # sequence-parallel transformer encoder self-attention over the sp
     # mesh axis: "" (off), "ring" (K/V blocks rotate via ppermute with an
     # online softmax — no device ever holds the full [T, T] score
@@ -730,6 +750,28 @@ class HParams:
             raise ValueError(
                 f"serve_scrape_interval_ms must be >= 0 (0 = scrape "
                 f"every read), got {self.serve_scrape_interval_ms}")
+        if self.hier_chunk_words < 0:
+            raise ValueError(
+                f"hier_chunk_words must be >= 0 (0 = max_enc_steps), "
+                f"got {self.hier_chunk_words}")
+        if self.hier_chunk_words > self.max_enc_steps:
+            raise ValueError(
+                f"hier_chunk_words={self.hier_chunk_words} exceeds "
+                f"max_enc_steps={self.max_enc_steps}: a chunk wider than "
+                f"the encoder horizon is silently truncated at "
+                f"tokenization and its cache key lies about its content")
+        effective_chunk = self.hier_chunk_words or self.max_enc_steps
+        if not 0 <= self.hier_overlap_words < effective_chunk:
+            raise ValueError(
+                f"hier_overlap_words must be in [0, chunk_words="
+                f"{effective_chunk}) so the chunk stride stays >= 1, "
+                f"got {self.hier_overlap_words}")
+        for name in ("hier_chunk_tier", "hier_reduce_tier"):
+            tier = getattr(self, name)
+            if tier and tier not in SERVE_TIERS:
+                raise ValueError(
+                    f"{name} must be one of {SERVE_TIERS} (or '' for "
+                    f"the default), got {tier!r}")
         if self.faults:
             # parse for validation only (unknown points / bad probs fail
             # here, at config time, not at the injection site)
@@ -921,6 +963,16 @@ def resolve_refill_chunk(hps: "HParams") -> int:
     clamped to [1, max_dec_steps]."""
     chunk = hps.serve_refill_chunk or beam_chunk_from_env()
     return max(1, min(int(chunk), hps.max_dec_steps))
+
+
+def resolve_hier_chunk_words(hps: "HParams") -> int:
+    """Effective map-pass chunk width for hierarchical summarization:
+    ``hier_chunk_words``, or the full encoder horizon when 0.  The ONE
+    resolver — serve/hiersum.py's chunker, the SLO gate's document
+    construction, and bench's fingerprint all derive through here so no
+    two components can disagree about where a chunk boundary falls
+    (boundary drift would silently break the append-path cache pins)."""
+    return hps.hier_chunk_words or hps.max_enc_steps
 
 
 def flash_mode_from_env() -> str:

@@ -57,3 +57,30 @@ class TenantThrottledError(ServeOverloadError):
 class ReplicaKilledError(ServeError):
     """The replica holding this request died mid-decode; the request is
     requeue-eligible (the FleetRouter re-enqueues it on a survivor)."""
+
+
+class HierPartialFailureError(ServeError):
+    """A hierarchical document request (serve/hiersum.py) could not
+    complete its map-reduce: one or more chunk sub-requests — or the
+    reduce pass — failed with a typed cause.  Raised on the PARENT
+    future exactly once, and only after every outstanding chunk future
+    has resolved (no orphaned sub-requests); the per-chunk verdicts
+    ride ``failed`` keyed by chunk index (or the string "reduce").
+
+    Partial output is never fabricated: a document summary missing a
+    chunk would be a silently-wrong answer, which is worse than a typed
+    failure the caller can retry (SERVING.md "Hierarchical
+    summarization")."""
+
+    def __init__(self, uuid: str, failed: dict, chunks: int):
+        self.uuid = uuid
+        #: {chunk index | "reduce": the sub-request's typed error}
+        self.failed = failed
+        #: total chunk fan-out width of the document
+        self.chunks = chunks
+        parts = ", ".join(
+            f"{k}: {type(v).__name__}" for k, v in sorted(
+                failed.items(), key=lambda kv: str(kv[0])))
+        super().__init__(
+            f"hierarchical request {uuid!r}: {len(failed)} of "
+            f"{chunks} chunk sub-request(s) failed ({parts})")

@@ -448,7 +448,9 @@ class FleetRouter:
     # -- request API --
     def submit(self, article: str, uuid: str = "", reference: str = "",
                block: bool = False, timeout: Optional[float] = None,
-               tier: str = "", tenant: str = "") -> ServeFuture:
+               tier: str = "",
+               trace: Optional[obs.TraceContext] = None,
+               tenant: str = "") -> ServeFuture:
         """Route one request to the least-loaded in-rotation replica;
         returns the ROUTER-level future (resolves exactly once, from
         whichever replica attempt wins).  Raises the typed
@@ -467,7 +469,11 @@ class FleetRouter:
         One TraceContext is minted here and threaded through every
         replica attempt, so the uuid's cross-replica lifecycle
         (enqueue -> route -> [kill -> requeued -> route] -> resolve)
-        reconstructs from one events.jsonl (OBSERVABILITY.md)."""
+        reconstructs from one events.jsonl (OBSERVABILITY.md).  An
+        EXPLICIT ``trace`` wins over the mint (ISSUE 19): the
+        hierarchical summarizer threads one PARENT context's children
+        through every chunk sub-request, so a document's whole fan-out
+        shares one trace_id across router and replicas alike."""
         with self._lock:
             if self._closed:
                 raise ServeClosedError("fleet router is stopped")
@@ -490,7 +496,7 @@ class FleetRouter:
                 track_rejection(self._reg, tenant, tier)
                 raise
             kind, val = self._door.open(article, tier, uuid, reference,
-                                        tenant=tenant)
+                                        trace=trace, tenant=tenant)
             if kind in ("hit", "follower"):
                 # hits and followers ARE fleet admissions (the counter's
                 # documented meaning, and the hedge waste cap's
@@ -502,7 +508,8 @@ class FleetRouter:
                 return self._track_request(val, tenant, tier)
             if kind == "leader":
                 flight = val
-        ctx = obs.TraceContext.new() if self._reg.enabled else None
+        ctx = trace if trace is not None else (
+            obs.TraceContext.new() if self._reg.enabled else None)
         future = ServeFuture(uuid, registry=self._reg)
         future.trace = ctx
         future.scope = "fleet"  # the TERMINAL resolve in the trace

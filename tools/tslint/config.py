@@ -141,6 +141,16 @@ DEFAULT: Dict[str, Any] = {
                 r"^RemoteReplica\.(scrape_healthz|_on_reply|load)$",
                 r"^_ReplySource\.rows$",
                 r"^ProcFleet\.(supervise_once|_supervise_loop)$",
+                # the hierarchical summarizer's fan-out driver (ISSUE
+                # 19): _fan_out runs once per document on the submit
+                # path, and the chunk-done/record/map-complete/reduce-
+                # done chain runs inside the SERVER's resolve callbacks
+                # — a host sync in any of them stalls the dispatch
+                # thread for every resident request, and the frame
+                # assembler feeds on every pipeline row
+                r"^HierarchicalSummarizer\.(_fan_out|_chunk_done"
+                r"|_record_chunk|_map_complete|_reduce_done)$",
+                r"^DocumentAssembler\.feed$",
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
