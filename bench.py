@@ -78,9 +78,17 @@ Modes (BENCH_MODE):
                     sequential per-chunk baseline plus the append
                     pass's cache_hit_rate (SERVING.md "Hierarchical
                     summarization"); fingerprint axis only when armed.
+                    `--serve-arena-pages=N` (BENCH_SERVE_ARENA_PAGES)
+                    runs the continuous engine over the ISSUE-20 paged
+                    resident state — an N-page block-granular arena
+                    (SERVING.md "Paged resident state"); fingerprint
+                    axis only when armed.
                     Every serve row carries `cache_hit_rate`,
-                    `coalesced_total`, and `decodes_per_submit` (1.0
-                    with the door dark — each submit decodes).
+                    `coalesced_total`, `decodes_per_submit` (1.0
+                    with the door dark — each submit decodes),
+                    `arena_fill_mean`, and
+                    `resident_bytes_per_slot_mean` (the provisioned
+                    dense worst case on unarmed rows).
   bytes           — XLA cost-analysis byte accounting for the train
                     step (no execution; CPU-forced like input mode):
                     bytes accessed + intensity for the baseline config
@@ -457,6 +465,15 @@ def _config_fingerprint() -> dict:
                 ("1", "on", "true", "yes"):
             fp["hier_chunks"] = int(os.environ.get("BENCH_HIER_CHUNKS",
                                                    "6"))
+        # paged-arena axis (ISSUE 20): an armed arena runs the PAGED
+        # slot kernels (page-table gathers, pooled encoder leaves) and
+        # admission is gated by free pages — a different memory story
+        # AND a different admission policy than dense residents, so
+        # arena rows must never stand in for dense rows.  Non-default
+        # only, house convention, so banked dense records keep matching;
+        # the page count IS the axis (capacity changes backpressure).
+        if int(os.environ.get("BENCH_SERVE_ARENA_PAGES", "0") or 0) > 0:
+            fp["arena"] = int(os.environ["BENCH_SERVE_ARENA_PAGES"])
     if mode == "decode":
         # while vs scan vs chunked decode loops differ by ~1.4 ms per
         # dynamic iteration on the tunneled backend — never
@@ -1594,6 +1611,19 @@ def bench_serve() -> None:
             f"BENCH_SERVE_ZIPF must be >= 0 (0 = off), got {zipf_s}")
     cache_entries = int(os.environ.get("BENCH_SERVE_CACHE", "256")) \
         if zipf_s > 0 else 0
+    # paged resident state (ISSUE 20): BENCH_SERVE_ARENA_PAGES=N arms
+    # the block-granular page arena — continuous mode decodes through
+    # the paged slot kernels and admission waits on free pages
+    arena_pages = int(os.environ.get("BENCH_SERVE_ARENA_PAGES", "0") or 0)
+    if arena_pages < 0:
+        raise ValueError(
+            f"BENCH_SERVE_ARENA_PAGES must be >= 0 (0 = dense), got "
+            f"{arena_pages}")
+    if arena_pages and serve_mode != "continuous":
+        raise ValueError(
+            "the page arena serves the continuous engine's residents; "
+            "drop BENCH_SERVE_ARENA_PAGES or use "
+            "BENCH_SERVE_MODE=continuous")
     hps = HParams(batch_size=batch, mode="decode", coverage=True,
                   serve_max_wait_ms=wait_ms, serve_mode=serve_mode,
                   serve_slots=slots, serve_refill_chunk=refill_chunk,
@@ -1601,6 +1631,7 @@ def bench_serve() -> None:
                   serve_replicas=replicas_n, serve_hedge_ms=hedge_ms,
                   serve_coalesce=zipf_s > 0,
                   serve_cache_entries=cache_entries,
+                  serve_arena_pages=arena_pages,
                   **_preset_overrides())
     if tier in ("spec", "draft"):
         # the draft model source: the mapped bootstrap for the
@@ -1755,6 +1786,13 @@ def bench_serve() -> None:
             hits0 = reg.counter("serve/cache_hits_total").value
             misses0 = reg.counter("serve/cache_misses_total").value
             coalesced0 = reg.counter("serve/coalesced_total").value
+            # arena evidence snapshots (ISSUE 20): the fill histogram
+            # gets one observation per refill tick, so the timed
+            # delta's mean is the run's mean arena occupancy
+            arena_h = reg.histogram("serve/arena_fill")
+            arena_fill0 = (arena_h.count, arena_h.sum)
+            arena_fail0 = reg.counter(
+                "serve/arena_alloc_failures_total").value
             lat: list = []
             # trace-derived per-request breakdown (ISSUE 9 satellite):
             # TEE the timed phase's lifecycle events into memory (an
@@ -1821,6 +1859,30 @@ def bench_serve() -> None:
             c0, s0, _ = phases0.get(name, (0, 0.0, 0.0))
             n = c1 - c0
             return round(1e3 * (s1 - s0) / n, 3) if n else 0.0
+
+        # arena occupancy over the timed window + the resident-bytes
+        # accounting it implies (ISSUE 20).  decode_resident_bytes is
+        # eval_shape only (no compile) at the ENGINE's slot count; the
+        # paged mean prices the fixed per-slot share plus the measured
+        # mean pages in use per slot — the same accounting the
+        # BYTE_BUDGET decode.resident gate commits, fed with this run's
+        # observed fill instead of an assumed mix.
+        arena_ticks = arena_h.count - arena_fill0[0]
+        arena_fill_mean = round(
+            (arena_h.sum - arena_fill0[1]) / arena_ticks, 4) \
+            if arena_ticks else 0.0
+        from __graft_entry__ import decode_resident_bytes
+
+        slots_n = resolve_serve_slots(hps)
+        rb = decode_resident_bytes(hps.replace(batch_size=slots_n),
+                                   pages=arena_pages or None)
+        if arena_pages:
+            resident_mean = int(
+                rb["paged_fixed_bytes_per_slot"]
+                + arena_fill_mean * arena_pages * rb["page_bytes"]
+                / slots_n)
+        else:
+            resident_mean = int(rb["dense_bytes_per_slot"])
 
         # per-uuid first-occurrence timestamps of each lifecycle stage
         per_req: dict = {}
@@ -1908,6 +1970,20 @@ def bench_serve() -> None:
             "decodes_per_submit": round(
                 (reg.counter("serve/completed_total").value - completed0)
                 / reqs, 4),
+            # paged-arena evidence (ISSUE 20; every serve row, like
+            # cache_hit_rate): mean arena occupancy over the timed
+            # window (one fill observation per refill tick; 0.0 on
+            # dense rows — the histogram never fires) and the MEAN
+            # resident bytes one slot actually held — dense rows report
+            # the provisioned worst case, arena rows price the fixed
+            # share plus the measured mean pages in use.  Fields ride
+            # the row; only BENCH_SERVE_ARENA_PAGES is a fingerprint
+            # axis.
+            "arena_fill_mean": arena_fill_mean,
+            "resident_bytes_per_slot_mean": resident_mean,
+            "arena_alloc_failures_total": int(
+                reg.counter("serve/arena_alloc_failures_total").value
+                - arena_fail0),
             # telemetry-plane evidence (ISSUE 15): per-tier fast-window
             # burn rates off the installed SLO engine (SLO_POLICY.json
             # tier_latency objective; {} when no engine installed) and
@@ -2262,6 +2338,12 @@ if __name__ == "__main__":
             os.environ["BENCH_SERVE_HIER"] = "1"
             if "=" in arg:
                 os.environ["BENCH_HIER_CHUNKS"] = arg.split("=", 1)[1]
+        elif arg.startswith("--serve-arena-pages="):
+            # `--serve-arena-pages=N`: the ISSUE-20 paged resident
+            # state — continuous engine over an N-page arena
+            os.environ["BENCH_MODE"] = "serve"
+            os.environ["BENCH_SERVE_MODE"] = "continuous"
+            os.environ["BENCH_SERVE_ARENA_PAGES"] = arg.split("=", 1)[1]
     if os.environ.get("TS_BENCH_CHILD") == "1":
         child_main()
     else:

@@ -278,4 +278,93 @@ print(f"serve.cache_fault OK: {fires} injected cache faults degraded to "
 PY
 
 echo
+echo "== TS_FAULTS sweep: serve.arena_full (paged admission requeues, never rejects)"
+TS_FAULTS="serve.arena_full:1.0:0:2" python - <<'PY'
+import glob
+import tempfile
+from textsummarization_on_flink_tpu import obs
+from textsummarization_on_flink_tpu.config import HParams
+from textsummarization_on_flink_tpu.data.vocab import Vocab
+from textsummarization_on_flink_tpu.decode.decoder import DecodedResult
+from textsummarization_on_flink_tpu.obs import flightrec
+from textsummarization_on_flink_tpu.resilience import faultinject
+from textsummarization_on_flink_tpu.serve.server import ServingServer
+
+class NullDecoder:
+    def maybe_reload_checkpoint(self, last):
+        return last
+
+class PagedSimEngine:
+    """Jax-free paged slot engine (ISSUE 20): a 4-page arena over 2
+    slots, 2 decode chunks per request — the REAL ContinuousBatcher
+    does the page-gated admission; the armed serve.arena_full point
+    lands the allocation failure inside pack."""
+    paged = True
+    def __init__(self, slots=2, pages=4, page_words=4):
+        self.slots, self._cap = slots, pages
+        self._free = list(range(pages))
+        self._page_words = page_words
+        self._held = [[] for _ in range(slots)]
+        self._rem = [0] * slots
+    def prefill(self, ex):
+        return ex
+    def pages_needed(self, ex):
+        words = len(ex.original_article.split())
+        return max(1, -(-words // self._page_words))
+    def free_pages(self):
+        return len(self._free)
+    def arena_stats(self):
+        in_use = self._cap - len(self._free)
+        return {"capacity": self._cap, "free": len(self._free),
+                "in_use": in_use, "fill": in_use / self._cap}
+    def pack(self, idx, ex):
+        self._held[idx] = [self._free.pop()
+                           for _ in range(self.pages_needed(ex))]
+        self._rem[idx] = 2
+    def step(self):
+        fin = []
+        for i in range(self.slots):
+            if self._rem[i] > 0:
+                self._rem[i] -= 1
+                if self._rem[i] == 0:
+                    fin.append(i)
+        return fin
+    def _release_pages(self, idx):
+        self._free.extend(self._held[idx])
+        self._held[idx] = []
+    def unpack(self, idx, ex):
+        self._release_pages(idx)
+        return DecodedResult(uuid=ex.uuid, article=ex.original_article,
+                             decoded_words=["ok", "."],
+                             reference=ex.reference, abstract_sents=[])
+    def release(self, idx):
+        self._release_pages(idx)
+        self._rem[idx] = 0
+
+vocab = Vocab(words=["w"])
+hps = HParams(mode="decode", batch_size=2, vocab_size=vocab.size(),
+              max_enc_steps=8, max_dec_steps=6, beam_size=2,
+              min_dec_steps=1, max_oov_buckets=4, serve_max_queue=64,
+              serve_mode="continuous", serve_slots=2, serve_refill_chunk=1)
+ring = tempfile.mkdtemp()
+reg = obs.registry()
+flightrec.install_flight_recorder(reg, ring)
+engine = PagedSimEngine()
+with ServingServer(hps, vocab, decoder=NullDecoder(),
+                   engine=engine) as server:
+    futs = [server.submit("w w w w w w .", uuid=f"u{i}") for i in range(8)]
+    results = [f.result(timeout=60) for f in futs]
+assert [r.uuid for r in results] == [f"u{i}" for i in range(8)]
+fires = faultinject.plan().stats()["serve.arena_full"]["fires"]
+fails = int(reg.counter("serve/arena_alloc_failures_total").value)
+assert fires == 2 and fails >= 2, (fires, fails)
+assert engine.arena_stats()["in_use"] == 0, engine.arena_stats()
+dumps = glob.glob(ring + "/flight_arena_exhausted*.jsonl")
+assert len(dumps) == 1, dumps  # rising edge only: ONE dump per episode
+print(f"serve.arena_full OK: {fires} injected allocation failures "
+      f"requeued (never rejected), 8 futures resolved exactly once, "
+      f"arena drained to 0, 1 flight dump ({dumps[0].rsplit('/', 1)[-1]})")
+PY
+
+echo
 echo "chaos OK"

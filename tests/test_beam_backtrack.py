@@ -485,3 +485,280 @@ def test_warm_set_is_four_plus_one_prefill_per_bucket():
         assert churn_hits == 7, after  # 2 prefills + 2 packs + 2 steps + 1 unpack
         # within budget on every site => the storm trigger stayed silent
         assert profile_lib.profile_alerts(reg)["compile_storm"] is None
+
+
+# -- paged resident state parity (ISSUE 20) --------------------------------
+#
+# The page arena replaced the slot state's worst-case per-slot leaves
+# with pools of decode_enc_block-row pages addressed through a per-slot
+# page table (data, not shape).  The mirror stays the FULL-WIDTH dense
+# search: exactness across page-boundary article lengths, arena-full
+# backpressure, and harvest-then-reuse page recycling is the claim that
+# paging changed the MEMORY story, not the numerics.
+
+from textsummarization_on_flink_tpu.decode.arena import (  # noqa: E402
+    ArenaExhaustedError,
+    PageArena,
+)
+
+#: article lengths at the page-layout edge cases for block=4 on the
+#: 12-wide test scale (b_max=3): exactly ONE full page, straddling a
+#: page boundary (block+1), the minimal 1-token article, and the full
+#: 3-page grid — packed together (mixed page-count occupancy).
+_PAGED_LENS = (4, 5, 1, 12)
+
+
+def _scratch_row(row_ids, b_max, pages):
+    row = np.full(b_max, pages, np.int32)
+    row[:len(row_ids)] = row_ids
+    return row
+
+
+def _drive_slots_paged(params, hps, state, table, slots, chunk=3,
+                       max_chunks=16):
+    active = np.ones(slots, bool)
+    done = {}
+    for _ in range(max_chunks):
+        state, fin = beam_search.step_slots_paged_jit(
+            params, hps, state, active, np.asarray(table), chunk)
+        for s in np.nonzero(np.asarray(fin))[0]:
+            done[int(s)] = beam_search.unpack_slot_paged_jit(
+                hps, state, int(s), np.asarray(table)[int(s)])
+            active[s] = False
+        if not active.any():
+            break
+    return state, done
+
+
+@pytest.mark.parametrize("family_name,hps", FAMILY_CASES)
+def test_paged_kernels_match_mirror_at_page_boundaries(family_name, hps):
+    """Mixed page-count occupancy through the PAGED slot path: each
+    article allocated ceil(len/block) real arena pages (scratch fill
+    beyond), decoded together through the page-table gather, and every
+    trajectory must match the full-width materialized mirror
+    token-exactly — including the article whose length is exactly one
+    page and the one straddling a page boundary."""
+    hps = hps.replace(batch_size=len(_PAGED_LENS), decode_enc_block=4)
+    family = get_family(family_name)
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(3))
+    arrays = _arrays_with_lens(hps, _PAGED_LENS, seed=6)
+    slots = len(_PAGED_LENS)
+    block, b_max = 4, 3
+    arena = PageArena(9)  # 1+2+1+3 pages needed of 9
+    zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
+            for k, v in arrays.items()}
+    state = beam_search.init_slots_paged_jit(params, hps, zero,
+                                             arena.capacity)
+    table = np.full((slots, b_max), arena.capacity, np.int32)
+    for slot, true_len in enumerate(_PAGED_LENS):
+        bucket = next(b for b in _DISAGG_BUCKETS if true_len <= b)
+        one = {k: (v[slot:slot + 1, :bucket] if v.ndim == 2
+                   else v[slot:slot + 1])
+               for k, v in arrays.items()}
+        pre = beam_search.prefill_jit(params, hps, one)
+        ids = arena.alloc(max(1, -(-true_len // block)))
+        row = _scratch_row(ids, b_max, arena.capacity)
+        state = beam_search.pack_slot_paged_jit(params, hps, state, slot,
+                                                pre, row)
+        table[slot] = row
+    assert arena.pages_in_use == 7
+    np.testing.assert_array_equal(
+        np.asarray(state.enc_valid_len), np.asarray(_PAGED_LENS))
+    _, done = _drive_slots_paged(params, hps, state, table, slots)
+    assert sorted(done) == list(range(slots))
+    for b in range(slots):
+        ref = materialized_search(params, hps, family, arrays, b)
+        _assert_slot_matches_mirror(done[b], ref)
+
+
+@pytest.mark.parametrize("family_name,hps", FAMILY_CASES)
+def test_paged_arena_full_backpressure_then_recycle_exact(family_name,
+                                                          hps):
+    """The backpressure + recycling contract at the kernel level: with
+    the arena sized for ONE full-length resident, the second admission's
+    allocation fails TYPED and all-or-nothing (no pages leak, the
+    resident is untouched); after the first article harvests and frees,
+    the retried admission reuses the very same page ids in a DIFFERENT
+    slot — and still decodes token-exactly against the mirror, proving
+    recycled pages carry no ghost of their previous tenant (the
+    harvested slot's stale table row routes to scratch, never to the
+    reused pages)."""
+    hps = hps.replace(batch_size=2, decode_enc_block=4)
+    family = get_family(family_name)
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(3))
+    arrays = _arrays_with_lens(hps, (12, 12), seed=6)
+    block, b_max = 4, 3
+    arena = PageArena(3)  # exactly one 3-page resident fits
+    zero = {k: np.zeros((2,) + v.shape[1:], v.dtype)
+            for k, v in arrays.items()}
+    state = beam_search.init_slots_paged_jit(params, hps, zero,
+                                             arena.capacity)
+    table = np.full((2, b_max), arena.capacity, np.int32)
+
+    def pack(slot, src_row, ids):
+        one = {k: v[src_row:src_row + 1] for k, v in arrays.items()}
+        pre = beam_search.prefill_jit(params, hps, one)
+        row = _scratch_row(ids, b_max, arena.capacity)
+        table[slot] = row
+        return beam_search.pack_slot_paged_jit(params, hps, state, slot,
+                                               pre, row)
+
+    ids_a = arena.alloc(3)
+    state = pack(0, 0, ids_a)
+    # the second full-length admission cannot get pages: typed, carries
+    # the shortfall, allocates NOTHING
+    with pytest.raises(ArenaExhaustedError) as exc:
+        arena.alloc(3)
+    assert exc.value.needed == 3 and exc.value.free == 0
+    assert arena.free_pages == 0 and arena.pages_in_use == 3
+    # drive the resident alone to completion — the blocked admission
+    # never touched it
+    active = np.array([True, False])
+    done0 = None
+    for _ in range(16):
+        state, fin = beam_search.step_slots_paged_jit(
+            params, hps, state, active, table, 3)
+        if np.asarray(fin)[0]:
+            done0 = beam_search.unpack_slot_paged_jit(hps, state, 0,
+                                                      table[0])
+            break
+    assert done0 is not None
+    ref0 = materialized_search(params, hps, family, arrays, 0)
+    _assert_slot_matches_mirror(done0, ref0)
+    # harvest frees the pages; the retried admission reuses the SAME ids
+    arena.free(ids_a.tolist())
+    table[0] = arena.capacity  # stale row -> scratch (engine contract)
+    ids_b = arena.alloc(3)
+    assert sorted(ids_b.tolist()) == sorted(ids_a.tolist())
+    state = pack(1, 1, ids_b)
+    _, done = _drive_slots_paged(params, hps, state, table, 2,
+                                 chunk=3)
+    ref1 = materialized_search(params, hps, family, arrays, 1)
+    _assert_slot_matches_mirror(done[1], ref1)
+
+
+def test_paged_warm_set_allocation_churn_never_recompiles():
+    """The ISSUE 20 compile pin: the paged engine warms with the SAME
+    four decode compiles (page-table contents, allocation pattern,
+    page-count mix, and occupancy are all traced data) plus one prefill
+    per bucket — and after the warm set, page recycling, permuted
+    allocation orders, different page counts per slot, and table
+    rewrites all land as ledger HITS, never compiles."""
+    # max_oov_buckets=5 keeps every aval distinct from the dense
+    # warm-set tests above, so the ledger counts FRESH compiles even in
+    # a shared-process run (the global jit caches persist across tests)
+    hps = PG_HPS.replace(max_oov_buckets=5, beam_size=2,
+                         decode_enc_block=4, batch_size=3)
+    family = get_family("pointer_generator")
+    params = family.init_params(hps, hps.vocab_size, jax.random.PRNGKey(2))
+    arrays = _arrays_with_lens(hps, (2, 7, 12), seed=5)
+    slots, b_max, pages = 3, 3, 7
+    zero = {k: np.zeros((slots,) + v.shape[1:], v.dtype)
+            for k, v in arrays.items()}
+    buckets = (4, 8, 12)
+    with obs.use_registry(Registry()) as reg:
+        prof = profile_lib.install_profiler(reg)
+        for kernel in ("decode/init_slots_jit", "decode/pack_slot_jit",
+                       "decode/step_slots_jit", "decode/unpack_slot_jit"):
+            prof.set_compile_budget(kernel, 1)
+        prof.set_compile_budget("decode/prefill_jit", len(buckets))
+
+        def call(site, fn, *args, key=""):
+            return profile_lib.compiled_call(reg, site, fn, *args, key=key)
+
+        def pre_at(slot, bucket):
+            one = {k: (v[slot:slot + 1, :bucket] if v.ndim == 2
+                       else v[slot:slot + 1])
+                   for k, v in arrays.items()}
+            return call("decode/prefill_jit", beam_search.prefill_jit,
+                        params, hps, one, key=bucket)
+
+        table = np.full((slots, b_max), pages, np.int32)
+
+        def pack(slot, bucket, ids):
+            row = _scratch_row(np.asarray(ids, np.int32), b_max, pages)
+            table[slot] = row
+            return call("decode/pack_slot_jit",
+                        beam_search.pack_slot_paged_jit, params, hps,
+                        state, slot, pre_at(slot, bucket), row)
+
+        state = call("decode/init_slots_jit",
+                     beam_search.init_slots_paged_jit, params, hps, zero,
+                     pages)
+        # warm: every bucket, differing page counts (1, 2, 3 pages)
+        state = pack(0, 4, [0])
+        state = pack(1, 8, [1, 2])
+        state = pack(2, 12, [3, 4, 5])
+        state, _ = call("decode/step_slots_jit",
+                        beam_search.step_slots_paged_jit, params, hps,
+                        state, np.array([True, True, True]), table, 2)
+        call("decode/unpack_slot_jit", beam_search.unpack_slot_paged_jit,
+             hps, state, 1, table[1])
+        stats = prof.compile_stats()
+        growth = {site: st["compiles"] for site, st in stats.items()}
+        assert growth == {"decode/init_slots_jit": 1,
+                          "decode/pack_slot_jit": 1,
+                          "decode/step_slots_jit": 1,
+                          "decode/unpack_slot_jit": 1,
+                          "decode/prefill_jit": len(buckets)}, stats
+        assert prof.warm_set_size() == 4 + len(buckets)
+        # allocation-pattern churn: recycled ids out of order, a
+        # different page count in the same slot, a non-contiguous
+        # allocation, shifting occupancy — all HITS
+        state = pack(1, 4, [6])                    # fewer pages, new id
+        state = pack(0, 8, [5, 1])                 # recycled, permuted
+        state, _ = call("decode/step_slots_jit",
+                        beam_search.step_slots_paged_jit, params, hps,
+                        state, np.array([True, False, True]), table, 2)
+        state = pack(2, 12, [2, 0, 4])             # recycled, shuffled
+        state, _ = call("decode/step_slots_jit",
+                        beam_search.step_slots_paged_jit, params, hps,
+                        state, np.array([False, True, True]), table, 2)
+        call("decode/unpack_slot_jit", beam_search.unpack_slot_paged_jit,
+             hps, state, 2, table[2])
+        after = prof.compile_stats()
+        assert prof.warm_set_size() == 4 + len(buckets), after
+        churn_hits = sum(st["hits"] for st in after.values()) \
+            - sum(st["hits"] for st in stats.values())
+        assert churn_hits == 9, after  # 3 prefills + 3 packs + 2 steps + 1 unpack
+        assert profile_lib.profile_alerts(reg)["compile_storm"] is None
+
+
+class TestPageArena:
+    """The host allocator's contract: LIFO reuse, all-or-nothing
+    allocation, loud double-free."""
+
+    def test_alloc_free_roundtrip_and_fill(self):
+        a = PageArena(4)
+        ids = a.alloc(3)
+        assert sorted(ids.tolist()) == [0, 1, 2]
+        assert (a.capacity, a.free_pages, a.pages_in_use) == (4, 1, 3)
+        assert a.fill == 0.75
+        a.free(ids.tolist())
+        assert a.free_pages == 4 and a.fill == 0.0
+
+    def test_alloc_is_all_or_nothing(self):
+        a = PageArena(4)
+        a.alloc(3)
+        with pytest.raises(ArenaExhaustedError) as exc:
+            a.alloc(2)
+        assert exc.value.needed == 2 and exc.value.free == 1
+        assert a.free_pages == 1  # the failed alloc took nothing
+
+    def test_lifo_reuse(self):
+        a = PageArena(4)
+        first = a.alloc(2)
+        a.free(first.tolist())
+        again = a.alloc(2)
+        assert sorted(again.tolist()) == sorted(first.tolist())
+
+    def test_double_free_and_bad_ids_raise(self):
+        a = PageArena(2)
+        ids = a.alloc(1)
+        a.free(ids.tolist())
+        with pytest.raises(ValueError):
+            a.free(ids.tolist())
+        with pytest.raises(ValueError):
+            a.free([7])
+        with pytest.raises(ValueError):
+            PageArena(0)

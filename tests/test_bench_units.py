@@ -147,6 +147,26 @@ def test_config_fingerprint_distinguishes_sweep_rows(monkeypatch):
     assert bench._config_fingerprint() == base
 
 
+def test_config_fingerprint_arena_axis_non_default_only(monkeypatch):
+    """The ISSUE-20 paged-arena axis: an armed arena runs different
+    kernels under a different admission policy, so it must split
+    records — but only when armed, so banked dense serve records keep
+    matching default asks."""
+    monkeypatch.setenv("BENCH_MODE", "serve")
+    for var in ("BENCH_SERVE_ARENA_PAGES", "BENCH_SERVE_MIX",
+                "BENCH_SERVE_TIER", "BENCH_SERVE_REPLICAS",
+                "BENCH_SERVE_ZIPF", "BENCH_SERVE_HIER"):
+        monkeypatch.delenv(var, raising=False)
+    base = bench._config_fingerprint()
+    assert "arena" not in base
+    monkeypatch.setenv("BENCH_SERVE_ARENA_PAGES", "24")
+    armed = bench._config_fingerprint()
+    assert armed != base and armed["arena"] == 24
+    # 0 is the dense sentinel, not an axis value
+    monkeypatch.setenv("BENCH_SERVE_ARENA_PAGES", "0")
+    assert bench._config_fingerprint() == base
+
+
 def _write_jsonl(path, recs):
     import json
 

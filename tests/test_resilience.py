@@ -330,7 +330,7 @@ class TestFaultSpecs:
             "io.connect", "io.read", "io.write",
             "ckpt.load", "train.step_nan", "etl.worker",
             "serve.dispatch", "serve.replica_kill", "serve.cache_fault",
-            "serve.proc_kill"}
+            "serve.proc_kill", "serve.arena_full"}
 
 
 class TestFaultPlan:

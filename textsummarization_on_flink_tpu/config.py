@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -276,6 +276,24 @@ class HParams:
     # already-encoded article instead of paying prefill latency inline.
     # 0 = prefill exactly the free slots.
     serve_prefill_depth: int = 2
+    # ---- paged resident state (PERF.md "Paged resident state"; ISSUE 20) ----
+    # Arena page count for the block-granular slot arena: the continuous
+    # engine's enc-axis resident leaves (encoder view / cross-attention
+    # KV cache, extended-vocab ids, attention history) become pools of
+    # decode_enc_block-row pages shared by all slots, and each admission
+    # allocates only ceil(true_len / block) pages — short requests stop
+    # reserving long-request memory, so the same HBM holds 2-4x the
+    # residents at the bimodal mix.  0 = paging off (dense SlotState)
+    # unless serve_arena_mb sets a byte budget.  Must be at least
+    # ceil(max_enc_steps / decode_enc_block) (one full-length article
+    # must fit) — enforced by resolve_arena_pages.
+    serve_arena_pages: int = 0
+    # Arena sizing by HBM byte budget instead of a page count: the page
+    # count becomes floor(serve_arena_mb MiB / page_bytes), where
+    # page_bytes spans one page across ALL pools
+    # (beam_search.paged_page_bytes).  Ignored when serve_arena_pages is
+    # set explicitly.  0 = no byte budget.
+    serve_arena_mb: float = 0.0
     # ---- speculative decode tier (SERVING.md "Quality tiers"; ISSUE 10) ----
     # Draft tokens proposed per verify cycle: the draft model (AAN
     # family) proposes spec_k tokens greedily, the full model scores all
@@ -707,6 +725,14 @@ class HParams:
             raise ValueError(
                 f"serve_prefill_depth must be >= 0, got "
                 f"{self.serve_prefill_depth}")
+        if self.serve_arena_pages < 0:
+            raise ValueError(
+                f"serve_arena_pages must be >= 0 (0 = paging off), got "
+                f"{self.serve_arena_pages}")
+        if self.serve_arena_mb < 0:
+            raise ValueError(
+                f"serve_arena_mb must be >= 0 (0 = no byte budget), got "
+                f"{self.serve_arena_mb}")
         if self.serve_replicas < 1:
             raise ValueError(
                 f"serve_replicas must be >= 1, got {self.serve_replicas}")
@@ -963,6 +989,39 @@ def resolve_refill_chunk(hps: "HParams") -> int:
     clamped to [1, max_dec_steps]."""
     chunk = hps.serve_refill_chunk or beam_chunk_from_env()
     return max(1, min(int(chunk), hps.max_dec_steps))
+
+
+def resolve_arena_pages(hps: "HParams",
+                        page_bytes: "Optional[int]" = None) -> int:
+    """Effective page count of the paged-resident-state arena (ISSUE
+    20): ``serve_arena_pages`` when set explicitly, else the page count
+    a ``serve_arena_mb`` HBM byte budget buys (page_bytes — one page's
+    span across all pools, beam_search.paged_page_bytes — is required
+    for budget mode), else 0 = paging off.  The ONE resolver, shared by
+    decode/decoder.SlotDecodeEngine, __graft_entry__'s cost model, and
+    bench.py's fingerprint, so the measured arena is exactly the served
+    one.  A non-zero result is validated to hold at least one
+    full-length article (ceil(max_enc_steps / decode_enc_block) pages)
+    — anything smaller would deadlock the first max-length admission
+    rather than backpressure it."""
+    b_max = -(-hps.max_enc_steps // resolve_enc_block(hps))
+    if hps.serve_arena_pages > 0:
+        pages = int(hps.serve_arena_pages)
+    elif hps.serve_arena_mb > 0:
+        if not page_bytes or page_bytes <= 0:
+            raise ValueError(
+                "serve_arena_mb sizing needs page_bytes "
+                "(beam_search.paged_page_bytes(params, hps))")
+        pages = int(hps.serve_arena_mb * (1 << 20) // page_bytes)
+    else:
+        return 0
+    if pages < b_max:
+        raise ValueError(
+            f"arena of {pages} page(s) cannot hold one full-length "
+            f"article ({b_max} pages of {resolve_enc_block(hps)} rows "
+            f"at max_enc_steps={hps.max_enc_steps}); raise "
+            f"serve_arena_pages/serve_arena_mb or decode_enc_block")
+    return pages
 
 
 def resolve_hier_chunk_words(hps: "HParams") -> int:

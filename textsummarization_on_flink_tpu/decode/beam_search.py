@@ -176,9 +176,15 @@ class _BeamState(NamedTuple):
     res_par: Array  # [K+1] int32 parent (pre-expansion) slot at finish
 
 
-def _init_beam_state(hps: HParams, T_enc: int, dec_state: Any) -> _BeamState:
+def _init_beam_state(hps: HParams, T_enc: int, dec_state: Any,
+                     attn_cols: Optional[int] = None) -> _BeamState:
     """The step-0 search state for one article (dec_state comes from the
-    family's beam adapter; everything else is shape-only)."""
+    family's beam adapter; everything else is shape-only).
+
+    attn_cols narrows the attention history to that many columns — the
+    paged slot path (ISSUE 20) keeps a single scratch column per slot
+    and scatters each step's row into the shared page pool instead of
+    carrying the full [K, T+1, T_enc] buffer per resident."""
     K = hps.beam_size
     T = hps.max_dec_steps
     return _BeamState(
@@ -189,7 +195,9 @@ def _init_beam_state(hps: HParams, T_enc: int, dec_state: Any) -> _BeamState:
         n_res=jnp.zeros((), jnp.int32),
         parent_hist=jnp.zeros((K, T + 1), jnp.int32),
         tok_hist=jnp.zeros((K, T + 1), jnp.int32),
-        attn_steps=jnp.zeros((K, T + 1, T_enc), jnp.float32),
+        attn_steps=jnp.zeros(
+            (K, T + 1 if attn_cols is None else attn_cols, T_enc),
+            jnp.float32),
         pgen_steps=jnp.zeros((K, T + 1), jnp.float32),
         res_lp=jnp.full((K + 1,), NEG, jnp.float32),
         res_len=jnp.ones((K + 1,), jnp.int32),
@@ -210,10 +218,16 @@ def _beam_cond(hps: HParams):
 
 
 def _make_beam_body(params, hps: HParams, step_fn, enc_one, enc_mask,
-                    ext_ids):
+                    ext_ids, attn_col_fn=None):
     """One decode step for one article, closed over its encoder view —
-    shared verbatim by the batch search (_search_one) and the slot loop
-    (step_slots_jit), so the two paths cannot drift."""
+    shared verbatim by the batch search (_search_one) and the slot loops
+    (step_slots_jit / step_slots_paged_jit), so the paths cannot drift.
+
+    attn_col_fn(t) overrides the attention-history write column — the
+    paged path (ISSUE 20) writes every step into its width-1 scratch
+    column (index 0) and scatters that row into the page pool OUTSIDE
+    this body; an explicit override, never out-of-bounds index
+    semantics, keeps the write well-defined."""
     K = hps.beam_size
     V = hps.vocab_size
     S = K * 2 * K  # candidate count per step
@@ -258,7 +272,8 @@ def _make_beam_body(params, hps: HParams, step_fn, enc_one, enc_mask,
         # the scratch column those writes land in (never read back)
         parent_hist = s.parent_hist.at[:, s.t].set(par)
         tok_hist = s.tok_hist.at[:, s.t].set(new_latest)
-        attn_steps = s.attn_steps.at[:, s.t].set(step.attn_dist)
+        attn_col = s.t if attn_col_fn is None else attn_col_fn(s.t)
+        attn_steps = s.attn_steps.at[:, attn_col].set(step.attn_dist)
         pgen_steps = s.pgen_steps.at[:, s.t].set(step.p_gen)
 
         # --- record finished hypotheses as scalar backpointers ---
@@ -552,14 +567,16 @@ class PrefillState(NamedTuple):
     enc_valid_len: Array  # [1] int32
 
 
-def _init_slot_beams(params, hps: HParams, enc_view, enc_mask):
+def _init_slot_beams(params, hps: HParams, enc_view, enc_mask,
+                     attn_cols: Optional[int] = None):
     """vmapped step-0 beam state for a stack of articles."""
     family = get_family(hps.model_family)
     init_state_fn, _ = family.beam_adapter(hps)
 
     def one(enc_one, mask):
         return _init_beam_state(hps, mask.shape[0],
-                                init_state_fn(params, enc_one))
+                                init_state_fn(params, enc_one),
+                                attn_cols=attn_cols)
 
     return jax.vmap(one)(enc_view, enc_mask)
 
@@ -701,6 +718,364 @@ def unpack_slot_jit(hps: HParams, state: SlotState, idx) -> BeamSearchOutput:
     and the next pack overwrites the state."""
     s = jax.tree_util.tree_map(lambda x: x[idx], state.beam)
     return _finalize_beam(hps, s, state.enc_mask.shape[1])
+
+
+# --------------------------------------------------------------------------
+# Paged resident state: the block-granular slot arena (ISSUE 20)
+# --------------------------------------------------------------------------
+#
+# PR 11's length masks cut the slot engine's COMPUTE to true article
+# lengths, but every resident still owned full-width encoder-axis
+# buffers: slot COUNT stayed provisioned for the worst-case article.
+# The paged kernel set below drops the per-slot reservation to page
+# granularity — the vLLM/PagedAttention block-table idea applied to this
+# engine's T_enc axis:
+#
+#   * every enc-axis leaf of the resident state — the family encoder
+#     view (for tf/aan that IS the cross-attention KV cache), the
+#     extended-vocab ids, and the [K, T+1, T_enc] attention history —
+#     becomes a POOL of `resolve_enc_block`-row pages shared by all
+#     slots, sized by the arena (decode/arena.PageArena) instead of
+#     slots x max_enc_steps;
+#   * each slot's pages are named by a per-slot PAGE-TABLE row — int32
+#     DATA passed as a traced argument, never shape: page-table
+#     contents, occupancy, and allocation pattern can never recompile
+#     (the PR 6/11 discipline), and the warm set stays 4 decode
+#     compiles + one prefill per bucket;
+#   * page index P (== arena capacity) is the SCRATCH page: every
+#     unused table entry points at it, inactive slots are routed to it
+#     inside the kernels, and its contents are garbage by contract —
+#     exactly the dead-column story the byte-diet histories already
+#     tell (see _SELECT_FIELDS);
+#   * dec_state stays DENSE on purpose: its big leaves (the tf
+#     self-attention KV cache) run over the DECODE axis, which the
+#     bimodal mix does not vary — paging them buys nothing at this
+#     workload while doubling the scatter traffic.  pg's [K, T_enc]
+#     coverage is enc-axis but second-order (one f32 row vs the 2H-wide
+#     encoder states); it rides dense too.
+#
+# Token-exactness is by construction, not tolerance: gathers through
+# the table reconstruct each ACTIVE slot's exact dense view (garbage
+# beyond a slot's valid pages sits behind the PR 11 valid-length masks,
+# whose exact-zero softmax contributes 0.0), and the per-step attention
+# row is scattered into the pool at the same (slot, t) coordinates the
+# dense path writes — the parity suite pins all three families bitwise
+# at page boundaries.
+#
+# Lifecycle (host side in decode/decoder.SlotDecodeEngine):
+#   pages = resolve_arena_pages(hps, paged_page_bytes(params, hps))
+#   state = init_slots_paged_jit(params, hps, zeros, pages=pages)
+#   row   = arena.alloc(ceil(len/block)) padded with scratch    # admit
+#   state = pack_slot_paged_jit(params, hps, state, i, pre, row)
+#   state, fin = step_slots_paged_jit(params, hps, state, active,
+#                                     table, chunk)   # table: [slots, B]
+#   out   = unpack_slot_paged_jit(hps, state, i, row); arena.free(row)
+
+
+class PagedSlotState(NamedTuple):
+    """Persistent decode state for the paged engine (ISSUE 20).
+
+    Relative to SlotState: the enc-axis leaves live in shared page
+    pools with one extra SCRATCH page at index [-1]; ``enc_rest`` keeps
+    the family enc_view's TREE STRUCTURE with each pooled leaf squeezed
+    to width 0 on its time axis (zero bytes, but the treedef and the
+    non-time leaves — e.g. pointer-generator's dec_in_state — survive
+    in place, so the kernels can rebuild the exact dense view by
+    re-probing `pad_enc_view`, the same single source of truth
+    prefill's padding uses).  The beam's attention history is a width-1
+    scratch column; each step's row is scattered into ``attn_pool`` at
+    the slot's pages.  ``enc_mask``/``enc_valid_len`` stay dense —
+    they ARE the masks that make page garbage contribute exact zeros.
+    """
+
+    beam: Any  # _BeamState, [slots, ...] leaves; attn_steps [slots,K,1,T_enc]
+    enc_rest: Any  # enc_view tree; pooled leaves squeezed to time-width 0
+    enc_pages: Any  # tuple of pools [pages+1, block, *tail], pool [-1]=scratch
+    ext_pool: Array  # [pages+1, block] int32 extended-vocab ids
+    attn_pool: Array  # [pages+1, K, T+1, block] f32 attention history pages
+    enc_mask: Array  # [slots, T_enc]
+    enc_valid_len: Array  # [slots] int32
+
+
+def _pool_spec(hps: HParams):
+    """(block, pages-per-slot-max, padded width) of the page layout —
+    block is resolve_enc_block (pages ARE the length-mask blocks, so
+    the PR 11 block chain and the arena agree on granularity)."""
+    from textsummarization_on_flink_tpu.config import resolve_enc_block
+
+    block = resolve_enc_block(hps)
+    b_max = -(-hps.max_enc_steps // block)
+    return block, b_max, block * b_max
+
+
+def _enc_time_axes(hps: HParams, enc_view):
+    """Per-leaf encoder-time axis of a (possibly width-0) enc_view,
+    probed by SHAPE through the family's own pad_enc_view: pad the view
+    past any real width and see which axis grew.  None marks a leaf
+    with no time axis (stays dense).  Pure eval_shape — runs at trace
+    time, costs nothing, and cannot drift from the padding the prefill
+    path actually performs."""
+    family = get_family(hps.model_family)
+    t_probe = hps.max_enc_steps + 17
+    padded = jax.eval_shape(lambda v: family.pad_enc_view(v, t_probe),
+                            enc_view)
+    axes = []
+    for a, b in zip(jax.tree_util.tree_leaves(enc_view),
+                    jax.tree_util.tree_leaves(padded)):
+        if tuple(a.shape) == tuple(b.shape):
+            axes.append(None)
+            continue
+        diff = [i for i, (x, y) in enumerate(zip(a.shape, b.shape))
+                if x != y]
+        if len(diff) != 1:
+            raise ValueError(
+                f"pad_enc_view changed more than one axis "
+                f"({a.shape} -> {b.shape}); cannot page this leaf")
+        axes.append(diff[0])
+    return tuple(axes)
+
+
+def _leaf_to_pages(leaf, ta: int, block: int, b_max: int):
+    """One prefilled [1, ...] enc leaf -> its [b_max, block, *tail] page
+    stack (time axis moved out front, zero-padded to the page grid)."""
+    x = jnp.moveaxis(leaf, ta, 1)[0]  # [T_enc, *tail]
+    pad = b_max * block - x.shape[0]
+    if pad:
+        x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+    return x.reshape((b_max, block) + x.shape[1:])
+
+
+def _pages_to_leaf(pool, pages, ta: int, T_enc: int):
+    """Gather a dense [slots, ...] enc leaf back out of its pool through
+    the page table (pages: [slots, b_max] int32; scratch rows carry
+    garbage that sits behind the valid-length masks)."""
+    g = pool[pages]  # [slots, b_max, block, *tail]
+    g = g.reshape((g.shape[0], g.shape[1] * g.shape[2]) + g.shape[3:])
+    return jnp.moveaxis(g[:, :T_enc], 1, ta)
+
+
+def paged_page_bytes(params, hps: HParams) -> int:
+    """Bytes ONE arena page spans across all pools (enc-view pages +
+    ext-id page + attention-history page) — the unit
+    config.resolve_arena_pages divides the HBM byte budget by.  Pure
+    eval_shape on the family's encoder view; jax-free callers pass the
+    params tree they already hold."""
+    block, _, _ = _pool_spec(hps)
+    family = get_family(hps.model_family)
+    probe = {
+        "enc_batch": jax.ShapeDtypeStruct((1, hps.max_enc_steps),
+                                          jnp.int32),
+        "enc_lens": jax.ShapeDtypeStruct((1,), jnp.int32),
+        "enc_padding_mask": jax.ShapeDtypeStruct((1, hps.max_enc_steps),
+                                                 jnp.float32),
+        "enc_batch_extend_vocab": jax.ShapeDtypeStruct(
+            (1, hps.max_enc_steps), jnp.int32),
+    }
+    view = jax.eval_shape(
+        lambda p, a: family.beam_encode(p, hps, a), params, probe)
+    axes = _enc_time_axes(hps, view)
+    total = 0
+    for leaf, ta in zip(jax.tree_util.tree_leaves(view), axes):
+        if ta is None:
+            continue
+        tail = int(np.prod([d for i, d in enumerate(leaf.shape)
+                            if i not in (0, ta)], dtype=np.int64))
+        total += block * tail * jnp.dtype(leaf.dtype).itemsize
+    total += block * 4  # ext_pool page (int32)
+    total += hps.beam_size * (hps.max_dec_steps + 1) * block * 4  # attn f32
+    return int(total)
+
+
+@functools.partial(jax.jit, static_argnames=("hps", "pages"))
+def init_slots_paged_jit(params, hps: HParams, arrays: Dict[str, Array],
+                         pages: int) -> PagedSlotState:
+    """The all-empty paged state: pools sized by the arena (`pages` is
+    the ONE static knob — fixed for the engine's lifetime, so this
+    stays one compile), everything else zeros.  Pool row `pages` is the
+    scratch page."""
+    family = get_family(hps.model_family)
+    enc_view = family.beam_encode(params, hps, arrays)
+    slots = arrays["enc_padding_mask"].shape[0]
+    block, b_max, _ = _pool_spec(hps)
+    axes = _enc_time_axes(hps, enc_view)
+    leaves, treedef = jax.tree_util.tree_flatten(enc_view)
+    rest, pools = [], []
+    for leaf, ta in zip(leaves, axes):
+        if ta is None:
+            rest.append(leaf)
+            continue
+        tail = tuple(d for i, d in enumerate(leaf.shape)
+                     if i not in (0, ta))
+        pools.append(jnp.zeros((pages + 1, block) + tail, leaf.dtype))
+        rest.append(jax.lax.slice_in_dim(leaf, 0, 0, axis=ta))
+    K, T = hps.beam_size, hps.max_dec_steps
+    return PagedSlotState(
+        beam=_init_slot_beams(params, hps, enc_view,
+                              arrays["enc_padding_mask"], attn_cols=1),
+        enc_rest=jax.tree_util.tree_unflatten(treedef, rest),
+        enc_pages=tuple(pools),
+        ext_pool=jnp.zeros((pages + 1, block), jnp.int32),
+        attn_pool=jnp.zeros((pages + 1, K, T + 1, block), jnp.float32),
+        enc_mask=arrays["enc_padding_mask"],
+        enc_valid_len=jnp.zeros((slots,), jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("hps",))
+def pack_slot_paged_jit(params, hps: HParams, state: PagedSlotState, idx,
+                        pre: PrefillState, row) -> PagedSlotState:
+    """Admit ONE prefilled article into slot `idx` with page-table row
+    `row` ([b_max] int32 — the slot's freshly allocated pages, padded
+    with the scratch id).  `idx` and `row` are both traced: one compile
+    serves every slot, every bucket, AND every allocation pattern.
+    Unused row entries all scatter into the scratch page (duplicate
+    writes there are unordered and don't matter — scratch holds garbage
+    by contract); stale attn pages from a page's previous tenant need
+    no clearing, because unpack masks columns past the new tenant's
+    valid length and the finalize backtrack masks steps past its
+    horizon."""
+    block, b_max, _ = _pool_spec(hps)
+    axes = _enc_time_axes(hps, pre.enc_view)
+    beam1 = _init_slot_beams(params, hps, pre.enc_view, pre.enc_mask,
+                             attn_cols=1)
+
+    def write(dst, src):
+        return dst.at[idx].set(src[0])
+
+    leaves = jax.tree_util.tree_leaves(pre.enc_view)
+    rest_leaves, treedef = jax.tree_util.tree_flatten(state.enc_rest)
+    rest_new, pool_new = [], []
+    pool_it = iter(state.enc_pages)
+    for leaf, rest_leaf, ta in zip(leaves, rest_leaves, axes):
+        if ta is None:
+            rest_new.append(rest_leaf.at[idx].set(leaf[0]))
+            continue
+        pool = next(pool_it)
+        pool_new.append(pool.at[row].set(
+            _leaf_to_pages(leaf, ta, block, b_max)))
+        rest_new.append(rest_leaf)  # width-0: nothing to write
+    ext = pre.ext_ids[0]
+    pad = b_max * block - ext.shape[0]
+    if pad:
+        ext = jnp.pad(ext, (0, pad))
+    return PagedSlotState(
+        beam=jax.tree_util.tree_map(write, state.beam, beam1),
+        enc_rest=jax.tree_util.tree_unflatten(treedef, rest_new),
+        enc_pages=tuple(pool_new),
+        ext_pool=state.ext_pool.at[row].set(ext.reshape(b_max, block)),
+        attn_pool=state.attn_pool,
+        enc_mask=state.enc_mask.at[idx].set(pre.enc_mask[0]),
+        enc_valid_len=state.enc_valid_len.at[idx].set(
+            pre.enc_valid_len[0]))
+
+
+@functools.partial(jax.jit, static_argnames=("hps", "chunk"))
+def step_slots_paged_jit(params, hps: HParams, state: PagedSlotState,
+                         active, table, chunk: int):
+    """Advance every ACTIVE slot by up to `chunk` masked decode steps,
+    gathering encoder state through the page table (`table`: [slots,
+    b_max] int32, traced DATA — occupancy and allocation pattern can
+    never recompile).
+
+    Structure: the dense per-slot encoder views are gathered ONCE per
+    chunk (loop-invariant — the gather cost amortizes over the chunk's
+    steps), then a top-level scan runs the chunk with a vmapped
+    per-slot masked step inside — scan-of-vmap instead of the dense
+    kernel's vmap-of-scan, which commutes (slots are independent; nb is
+    computed once outside either way) but exposes each step's
+    attention row for ONE scatter into the shared pool at (slot pages,
+    pre-step t).  Inactive slots' table rows are routed to the scratch
+    page before either the gather or the scatter, so a harvested slot's
+    stale table can never read from — or write garbage into — pages the
+    arena has re-issued to a new tenant.  Masked (post-finish) lanes
+    scatter garbage at their frozen t — a dead column of their OWN
+    pages, exactly the column the dense kernel lets them dirty."""
+    family = get_family(hps.model_family)
+    _, step_fn = family.beam_adapter_masked(hps)
+    cond = _beam_cond(hps)
+    from textsummarization_on_flink_tpu.config import resolve_enc_block
+
+    block = resolve_enc_block(hps)
+    _, b_max, t_pad = _pool_spec(hps)
+    T_enc = state.enc_mask.shape[1]
+    slots = active.shape[0]
+    K, T = hps.beam_size, hps.max_dec_steps
+    scratch = state.attn_pool.shape[0] - 1  # page id P, static
+    pages = jnp.where(active[:, None], table, scratch)
+
+    valid = jnp.where(active, state.enc_valid_len,
+                      jnp.zeros_like(state.enc_valid_len))
+    nb = (jnp.max(valid) + block - 1) // block  # scalar, traced
+
+    # rebuild the dense enc views once per chunk (loop-invariant)
+    axes = _enc_time_axes(hps, state.enc_rest)
+    rest_leaves, treedef = jax.tree_util.tree_flatten(state.enc_rest)
+    dense_leaves = []
+    pool_it = iter(state.enc_pages)
+    for leaf, ta in zip(rest_leaves, axes):
+        if ta is None:
+            dense_leaves.append(leaf)
+            continue
+        dense_leaves.append(_pages_to_leaf(next(pool_it), pages, ta,
+                                           T_enc))
+    enc_view = jax.tree_util.tree_unflatten(treedef, dense_leaves)
+    ext = state.ext_pool[pages].reshape(slots, t_pad)[:, :T_enc]
+
+    def one_step(beam, act, enc_one, mask, ext_one):
+        def step_nb(p, e, m, x, t, latest, s):
+            return step_fn(p, e, m, x, nb, t, latest, s)
+
+        body = _make_beam_body(params, hps, step_nb, enc_one, mask,
+                               ext_one, attn_col_fn=lambda t: 0)
+
+        def masked_cond(s):
+            return jnp.logical_and(act, cond(s))
+
+        s2, _ = _masked_scan_body(masked_cond, body)(beam, None)
+        return s2
+
+    flat_pages = pages.reshape(-1)  # [slots*b_max]
+
+    def chunk_body(carry, _):
+        beams, attn_pool = carry
+        t_old = beams.t  # [slots] pre-step write column (t <= T always)
+        beams2 = jax.vmap(one_step)(beams, active, enc_view,
+                                    state.enc_mask, ext)
+        attn = beams2.attn_steps[:, :, 0, :]  # [slots, K, T_enc]
+        pad = t_pad - T_enc
+        if pad:
+            attn = jnp.pad(attn, [(0, 0), (0, 0), (0, pad)])
+        vals = attn.reshape(slots, K, b_max, block).transpose(0, 2, 1, 3)
+        attn_pool = attn_pool.at[flat_pages, :,
+                                 jnp.repeat(t_old, b_max)].set(
+            vals.reshape(slots * b_max, K, block))
+        return (beams2, attn_pool), None
+
+    (beam, attn_pool), _ = jax.lax.scan(
+        chunk_body, (state.beam, state.attn_pool), None, length=chunk)
+    finished = jnp.logical_and(active,
+                               jnp.logical_not(jax.vmap(cond)(beam)))
+    return state._replace(beam=beam, attn_pool=attn_pool), finished
+
+
+@functools.partial(jax.jit, static_argnames=("hps",))
+def unpack_slot_paged_jit(hps: HParams, state: PagedSlotState, idx,
+                          row) -> BeamSearchOutput:
+    """The finished hypothesis for slot `idx`: gather the slot's
+    attention pages back into the dense [K, T+1, T_enc] history the
+    finalize backtrack expects (`row` is the slot's CURRENT table row —
+    the host frees the pages only after this call), zero columns past
+    the valid length (where the dense path's masked softmax wrote exact
+    zeros but a recycled page holds a previous tenant's rows), and run
+    the SAME _finalize_beam as every other path."""
+    K, T = hps.beam_size, hps.max_dec_steps
+    _, b_max, t_pad = _pool_spec(hps)
+    T_enc = state.enc_mask.shape[1]
+    s = jax.tree_util.tree_map(lambda x: x[idx], state.beam)
+    ap = state.attn_pool[row]  # [b_max, K, T+1, block]
+    attn = jnp.moveaxis(ap, 0, 2).reshape(K, T + 1, t_pad)[:, :, :T_enc]
+    valid = state.enc_valid_len[idx]
+    attn = jnp.where(jnp.arange(T_enc)[None, None, :] < valid, attn, 0.0)
+    return _finalize_beam(hps, s._replace(attn_steps=attn), T_enc)
 
 
 def resolved_chunk(loop: str) -> Optional[int]:

@@ -41,12 +41,18 @@ from textsummarization_on_flink_tpu.config import (
     bucket_for,
     derive_draft_hps,
     parse_bucket_spec,
+    resolve_arena_pages,
+    resolve_enc_block,
     resolve_spec_bounds,
 )
 from textsummarization_on_flink_tpu.data import oov as oov_lib
 from textsummarization_on_flink_tpu.data.batching import Batch
 from textsummarization_on_flink_tpu.data.vocab import STOP_DECODING, Vocab
 from textsummarization_on_flink_tpu.decode import beam_search
+from textsummarization_on_flink_tpu.decode.arena import (
+    ArenaExhaustedError,
+    PageArena,
+)
 from textsummarization_on_flink_tpu.evaluate import rouge
 from textsummarization_on_flink_tpu.resilience.policy import Deadline
 
@@ -710,6 +716,29 @@ class SlotDecodeEngine:
         self._state = None  # lazy: first pack pays the init compile
         self._active = np.zeros(slots, dtype=bool)
         self._obs = obs.registry_for(self._hps)
+        # ---- paged resident state (ISSUE 20) ----
+        # resolve_arena_pages > 0 switches the engine to the paged
+        # kernel set: enc-axis resident leaves pool into a shared
+        # decode_enc_block-row page arena, each admission allocates
+        # ceil(true_len/block) pages, and the per-slot page-table rows
+        # ride into the kernels as traced DATA.  Same four compile
+        # sites, same warm-set budget — paging changes the memory
+        # story, never the compile story.
+        self._block = resolve_enc_block(self._hps)
+        self._b_max = -(-self._hps.max_enc_steps // self._block)
+        self._page_bytes = 0
+        if self._hps.serve_arena_pages > 0 or self._hps.serve_arena_mb > 0:
+            self._page_bytes = beam_search.paged_page_bytes(
+                decoder._params_snapshot()[0], self._hps)
+        self._arena_pages = resolve_arena_pages(self._hps,
+                                                self._page_bytes or None)
+        self.paged = self._arena_pages > 0
+        self._arena: Optional[PageArena] = (
+            PageArena(self._arena_pages) if self.paged else None)
+        # scratch-filled page table; row i mirrors slot i's allocation
+        self._table = np.full((slots, self._b_max), self._arena_pages,
+                              np.int32)
+        self._page_rows: Dict[int, np.ndarray] = {}
         # commit the compile-once warm set to the compile ledger
         # (obs/profile.py, ISSUE 16): exactly one compile per decode
         # kernel (idx/occupancy/valid-lengths all traced) and one
@@ -803,10 +832,16 @@ class SlotDecodeEngine:
             specs = reg.slot_batch_specs()
             zero = {k: jax.device_put(v, reg.named(specs[k]))
                     for k, v in zero.items()}
-        self._state = self._pin_state(
-            self._jitted("decode/init_slots_jit",
-                         beam_search.init_slots_jit, params,
-                         self._hps, zero))
+        if self.paged:
+            self._state = self._pin_state(
+                self._jitted("decode/init_slots_jit",
+                             beam_search.init_slots_paged_jit, params,
+                             self._hps, zero, self._arena_pages))
+        else:
+            self._state = self._pin_state(
+                self._jitted("decode/init_slots_jit",
+                             beam_search.init_slots_jit, params,
+                             self._hps, zero))
 
     def _register_prefill_cost(self, bucket: int) -> None:
         """Queue analytic pricing of one prefill bucket for the
@@ -845,19 +880,91 @@ class SlotDecodeEngine:
                 pre, reg.shardings(reg.prefill_state_specs(pre)))
         return PrefilledArticle(example=example, state=pre, bucket=bucket)
 
+    def pages_needed(self, item) -> int:
+        """Arena pages one admission consumes: ceil(true_len / block),
+        read from the HOST-side example length (never the device
+        array — pack is a TS002 hot path).  0 when paging is off."""
+        if not self.paged:
+            return 0
+        enc_len = min(int(item.example.enc_len if isinstance(
+            item, PrefilledArticle) else item.enc_len),
+            self._hps.max_enc_steps)
+        return max(1, -(-enc_len // self._block))
+
+    def free_pages(self) -> int:
+        """Free arena pages (for the batcher's admit-by-free-pages
+        check); paging off reports the arena as bottomless."""
+        if not self.paged:
+            return 1 << 30
+        return self._arena.free_pages
+
+    def arena_stats(self) -> Optional[Dict[str, float]]:
+        """Arena occupancy snapshot for the serve metrics/bench
+        evidence fields; None when paging is off.  Pure host counters —
+        no device sync."""
+        if not self.paged:
+            return None
+        a = self._arena
+        return {"capacity": a.capacity, "free": a.free_pages,
+                "in_use": a.pages_in_use, "fill": a.fill}
+
+    def resident_bytes_per_slot(self) -> float:
+        """Mean resident HBM bytes one resident actually consumes —
+        the ISSUE 20 evidence figure.  Dense engine: the static
+        state-bytes / slots (every slot owns worst-case width whether
+        occupied or not).  Paged engine: the dense (non-pooled) per-slot
+        share plus the IN-USE pages' bytes averaged over current
+        residents — array metadata and host counters only, no sync."""
+        if self._state is None:
+            return 0.0
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(self._state)
+        total = float(sum(x.nbytes for x in leaves))
+        if not self.paged:
+            return total / self.slots
+        pools = list(self._state.enc_pages) + [self._state.ext_pool,
+                                               self._state.attn_pool]
+        dense = total - float(sum(x.nbytes for x in pools))
+        n_active = max(1, int(self._active.sum()))
+        return (dense / self.slots
+                + self._arena.pages_in_use * self._page_bytes / n_active)
+
     def pack(self, idx: int, item) -> None:
         """Admit one prefilled article (or a raw SummaryExample, which
-        is prefilled inline) into slot `idx` (must be free)."""
+        is prefilled inline) into slot `idx` (must be free).
+
+        Paged engine: allocates the admission's pages first — a typed
+        ArenaExhaustedError propagates to the batcher BEFORE any device
+        state changes (requeue, never a wrong decode), and a pack
+        failure after allocation frees the pages (no leak)."""
         if self._active[idx]:
             raise AssertionError(f"slot {idx} is already resident")
         if not isinstance(item, PrefilledArticle):
             item = self.prefill(item)
         params = self._params()
         self._ensure_state(params)
-        self._state = self._pin_state(
-            self._jitted("decode/pack_slot_jit",
-                         beam_search.pack_slot_jit, params,
-                         self._hps, self._state, idx, item.state))
+        if self.paged:
+            need = self.pages_needed(item)
+            ids = self._arena.alloc(need)  # may raise ArenaExhaustedError
+            row = np.full(self._b_max, self._arena_pages, np.int32)
+            row[:need] = ids
+            try:
+                self._state = self._pin_state(
+                    self._jitted("decode/pack_slot_jit",
+                                 beam_search.pack_slot_paged_jit, params,
+                                 self._hps, self._state, idx, item.state,
+                                 row))
+            except BaseException:
+                self._arena.free(ids)
+                raise
+            self._table[idx] = row
+            self._page_rows[idx] = ids
+        else:
+            self._state = self._pin_state(
+                self._jitted("decode/pack_slot_jit",
+                             beam_search.pack_slot_jit, params,
+                             self._hps, self._state, idx, item.state))
         self._active[idx] = True
 
     def step(self) -> List[int]:
@@ -872,9 +979,16 @@ class SlotDecodeEngine:
         # timestamp via its slot/tick lifecycle events, not by trace_id
         with obs.spans.span(self._obs, "decode/slot_chunk",
                             active=int(self._active.sum())):
-            self._state, finished = self._jitted(
-                "decode/step_slots_jit", beam_search.step_slots_jit,
-                params, self._hps, self._state, self._active, self.chunk)
+            if self.paged:
+                self._state, finished = self._jitted(
+                    "decode/step_slots_jit",
+                    beam_search.step_slots_paged_jit, params, self._hps,
+                    self._state, self._active, self._table, self.chunk)
+            else:
+                self._state, finished = self._jitted(
+                    "decode/step_slots_jit", beam_search.step_slots_jit,
+                    params, self._hps, self._state, self._active,
+                    self.chunk)
             self._state = self._pin_state(self._state)
             # the one sanctioned chunk-boundary sync: the host scheduler
             # needs the finished mask to retire and refill slots
@@ -886,9 +1000,16 @@ class SlotDecodeEngine:
         OOV map travel with the request, not the device state)."""
         if not self._active[idx]:
             raise AssertionError(f"slot {idx} is not resident")
-        out = self._jitted("decode/unpack_slot_jit",
-                           beam_search.unpack_slot_jit, self._hps,
-                           self._state, idx)
+        if self.paged:
+            out = self._jitted("decode/unpack_slot_jit",
+                               beam_search.unpack_slot_paged_jit,
+                               self._hps, self._state, idx,
+                               self._table[idx])
+            self._free_slot_pages(idx)
+        else:
+            out = self._jitted("decode/unpack_slot_jit",
+                               beam_search.unpack_slot_jit, self._hps,
+                               self._state, idx)
         self._active[idx] = False
         res = self._dec._make_result(
             np.asarray(out.tokens), int(out.length),
@@ -902,9 +1023,22 @@ class SlotDecodeEngine:
         self._dec._c_tokens.inc(len(res.decoded_words))
         return res
 
+    def _free_slot_pages(self, idx: int) -> None:
+        """Return slot `idx`'s pages to the arena and point its table
+        row back at the scratch page.  Safe after the unpack dispatch:
+        jit outputs are fresh buffers, so a later pack's scatter into
+        the recycled pages cannot race the retiring gather."""
+        ids = self._page_rows.pop(idx, None)
+        if ids is not None:
+            self._arena.free(ids)
+            self._table[idx] = self._arena_pages
+
     def release(self, idx: int) -> None:
         """Free slot `idx` WITHOUT unpacking (deadline eviction): the
-        stale state is masked out until the next pack overwrites it."""
+        stale state is masked out until the next pack overwrites it,
+        and a paged slot's pages go straight back to the arena."""
+        if self.paged:
+            self._free_slot_pages(idx)
         self._active[idx] = False
 
     def active_count(self) -> int:
@@ -914,11 +1048,22 @@ class SlotDecodeEngine:
         """Jit-cache entry counts of the four decode kernels plus the
         bucketed prefill — the 'bounded compile cache' evidence (tests
         assert the decode kernels never grow after warmup and prefill
-        stays at one entry per serve bucket)."""
+        stays at one entry per serve bucket).  In paged mode the four
+        kernels are the *_paged variants (ISSUE 20) — counting the
+        kernels this engine actually dispatches is what makes the pin
+        meaningful (the dense caches would sit frozen regardless)."""
+        if self.paged:
+            kernels = (beam_search.init_slots_paged_jit,
+                       beam_search.prefill_jit,
+                       beam_search.pack_slot_paged_jit,
+                       beam_search.step_slots_paged_jit,
+                       beam_search.unpack_slot_paged_jit)
+        else:
+            kernels = (beam_search.init_slots_jit, beam_search.prefill_jit,
+                       beam_search.pack_slot_jit, beam_search.step_slots_jit,
+                       beam_search.unpack_slot_jit)
         out: Dict[str, int] = {}
-        for fn in (beam_search.init_slots_jit, beam_search.prefill_jit,
-                   beam_search.pack_slot_jit, beam_search.step_slots_jit,
-                   beam_search.unpack_slot_jit):
+        for fn in kernels:
             try:
                 out[fn.__wrapped__.__name__] = fn._cache_size()
             except Exception:  # tslint: disable=TS005 — private jax API; absent on some builds

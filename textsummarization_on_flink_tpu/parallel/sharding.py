@@ -81,7 +81,7 @@ ENC_BATCH_NAMES = ("enc_batch", "enc_lens", "enc_padding_mask",
 #: documents each; tests assert coverage).
 ROLES = ("params", "opt_state", "step", "train_batch", "eval_batch",
          "metrics", "grads", "beam_output", "slot_state",
-         "prefill_batch", "prefill_state")
+         "prefill_batch", "prefill_state", "arena_pool", "page_table")
 
 
 # --------------------------------------------------------------------------
@@ -236,8 +236,44 @@ class ShardingRegistry:
         """Continuous-serving SlotState: every leaf leads with the
         [slots, ...] axis, sharded over dp (slots % dp == 0, validated
         by the engine); per-slot beams stay chip-local like the batch
-        search."""
+        search.
+
+        Paged resident state (ISSUE 20): a PagedSlotState splits into
+        two placement classes.  Slot-leading leaves (beam, enc_rest,
+        masks/lengths) keep the dp rule above.  The page POOLS and the
+        scratch row lead with the [pages+1, ...] arena axis, which has
+        no relation to dp — they replicate (role ``arena_pool``), and
+        the page TABLE passed alongside as data replicates too (role
+        ``page_table``); every chip addresses its slots' pages locally.
+        Sharding the arena itself over dp (per-chip sub-arenas with a
+        dp-local free list) is a deferred follow-on — it needs the host
+        allocator split per chip, not just a spec change here.
+        """
+        from textsummarization_on_flink_tpu.decode import beam_search
+
+        if isinstance(state, beam_search.PagedSlotState):
+            dp = jax.tree_util.tree_map(lambda _: P("dp"), state)
+            rep = jax.tree_util.tree_map(lambda _: self.arena_pool_spec(),
+                                         state)
+            return beam_search.PagedSlotState(
+                beam=dp.beam, enc_rest=dp.enc_rest,
+                enc_pages=rep.enc_pages, ext_pool=rep.ext_pool,
+                attn_pool=rep.attn_pool, enc_mask=dp.enc_mask,
+                enc_valid_len=dp.enc_valid_len)
         return jax.tree_util.tree_map(lambda _: P("dp"), state)
+
+    def arena_pool_spec(self) -> P:
+        """Page pools ([pages+1, block, ...] leaves of a
+        PagedSlotState): replicated — the arena axis is allocator
+        bookkeeping, not a device axis (see slot_state_specs)."""
+        return P()
+
+    def page_table_spec(self) -> P:
+        """The per-slot page table ([slots, B_max] int32, traced DATA
+        never shape): replicated, like the length/mask operands of the
+        compile-once kernels — it is tiny and consulted by every chip's
+        gather."""
+        return P()
 
     def slot_batch_specs(self) -> Dict[str, P]:
         """Encoder arrays stacked over slots (the slot-init contract):
@@ -326,6 +362,13 @@ class ShardingRegistry:
             {"role": "prefill_state", "spec": "same leading-axis rule "
                                               "as prefill_batch",
              "wire": "-"},
+            {"role": "arena_pool",
+             "spec": "P() — [pages+1, block, ...] pools replicate; the "
+                     "arena axis is allocator bookkeeping, not a device "
+                     "axis", "wire": "-"},
+            {"role": "page_table",
+             "spec": "P() — [slots, B_max] int32 traced data, "
+                     "replicated like length/mask operands", "wire": "-"},
         ]
         return rows
 

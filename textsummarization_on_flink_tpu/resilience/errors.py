@@ -20,6 +20,12 @@ RuntimeError/OSError, so callers can route on failure *class*:
     exhausted its restart budget; the first underlying error is chained.
     Subclasses ``RuntimeError`` so the pre-existing "producer thread
     failed" handlers keep working.
+  * ``ArenaExhaustedError`` — the paged-resident-state page arena
+    (decode/arena.PageArena, ISSUE 20) has fewer free pages than an
+    admission needs.  BACKPRESSURE, not failure: the ContinuousBatcher
+    requeues the admission until a harvest frees pages.  Defined here
+    (not in decode/) so the jax-free serve scheduler can catch it
+    without importing the jax-heavy decode package.
 
 ``NanLossError`` (divergence recovery gave up) lives in
 train/trainer.py next to its ``NonFiniteLossError`` base — the trainer
@@ -55,3 +61,13 @@ class CheckpointCorruptError(ResilienceError):
 
 class WorkerCrashError(ResilienceError):
     """A worker-thread pool exhausted its crash-restart budget."""
+
+
+class ArenaExhaustedError(ResilienceError):
+    """The page arena has fewer free pages than an admission needs
+    (typed allocation-failure backpressure; carries the shortfall)."""
+
+    def __init__(self, message: str, needed: int = 0, free: int = 0):
+        super().__init__(message)
+        self.needed = int(needed)
+        self.free = int(free)

@@ -22,6 +22,10 @@ injection points* compiled into the production code:
                       CHILD PROCESS mid-decode (the supervisor detects
                       the death, orphans requeue on survivors, the
                       child restarts under backoff)
+  ``serve.arena_full``  serve/batcher.py — page-arena allocation failure
+                      at slot refill (the admission REQUEUES under typed
+                      ArenaExhaustedError backpressure until a harvest
+                      frees pages; never a wrong decode, never a drop)
   ==================  =====================================================
 
 Arming — either source, same ``point:prob:seed[:max]`` syntax, comma-
@@ -66,7 +70,7 @@ KNOWN_POINTS = (
     "io.connect", "io.read", "io.write",
     "ckpt.load", "train.step_nan", "etl.worker",
     "serve.dispatch", "serve.replica_kill", "serve.cache_fault",
-    "serve.proc_kill",
+    "serve.proc_kill", "serve.arena_full",
 )
 
 

@@ -42,6 +42,7 @@ from textsummarization_on_flink_tpu.config import bucket_for as \
 from textsummarization_on_flink_tpu.data.batching import Batch
 from textsummarization_on_flink_tpu.data.vocab import Vocab
 from textsummarization_on_flink_tpu.resilience.errors import (
+    ArenaExhaustedError,
     DeadlineExceededError,
 )
 from textsummarization_on_flink_tpu.serve.queue import (
@@ -270,6 +271,18 @@ class ContinuousBatcher:
         # per-tenant cost accounting (ISSUE 15): decoded tokens charged
         # to the tenant whose request occupied the slot
         self._c_tenant_tokens = reg.counter("serve/tenant_tokens_total")
+        # paged-resident-state telemetry (ISSUE 20): arena occupancy per
+        # tick plus the allocation-failure backpressure count.  Emitted
+        # HERE rather than in the engine so the jax-free sim engines the
+        # SLO gate drives light the same series the real engine does —
+        # an engine without an arena surface simply never updates them.
+        self._supports_arena = bool(getattr(engine, "paged", False))
+        self._arena_blocked = False  # rising-edge state for the trigger
+        self._g_arena_pages = reg.gauge("serve/arena_pages_in_use")
+        self._c_arena_fail = reg.counter("serve/arena_alloc_failures_total")
+        self._h_arena_fill = reg.histogram(
+            "serve/arena_fill",
+            buckets=[0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 1.0])
 
     def busy(self) -> bool:
         return any(r is not None for r in self._resident)
@@ -446,9 +459,42 @@ class ContinuousBatcher:
                     if req is None:
                         return
                     payload = req.example
+                if self._supports_arena and self._supports_prefill:
+                    # admit by FREE PAGES, not free slots (ISSUE 20):
+                    # an admission that cannot get its pages goes BACK
+                    # to the head of the prefill queue — requeued, never
+                    # rejected — and this tick stops refilling (a later
+                    # entry stealing the pages would starve the head)
+                    need = self._engine.pages_needed(payload)
+                    free_pages = self._engine.free_pages()
+                    if need > free_pages:
+                        self._prefilled.appendleft((req, payload))
+                        self._arena_backpressure(need, free_pages)
+                        return
                 t0 = self._prof.start()
                 try:
+                    if (self._supports_arena and self._faults is not None
+                            and self._faults.fire("serve.arena_full")):
+                        raise ArenaExhaustedError(
+                            "injected serve.arena_full fault",
+                            needed=self._engine.pages_needed(payload),
+                            free=0)
                     self._engine.pack(idx, payload)
+                except ArenaExhaustedError as e:
+                    # typed backpressure from the engine's own alloc
+                    # (belt to the proactive check's suspenders, and the
+                    # chaos sweep's injection path): same requeue-never-
+                    # reject contract.  Only the prefill path holds a
+                    # repackable payload; a legacy direct-pack engine
+                    # with an arena would have to reject — the engine
+                    # guarantees prefill support whenever paged.
+                    if not self._supports_prefill:
+                        self._c_errors.inc()
+                        req.future._reject(e)
+                        raise
+                    self._prefilled.appendleft((req, payload))
+                    self._arena_backpressure(e.needed, e.free)
+                    return
                 except Exception as e:
                     # the request left the queue but never became
                     # resident: resolve it HERE, then let the server's
@@ -456,6 +502,8 @@ class ContinuousBatcher:
                     self._c_errors.inc()
                     req.future._reject(e)
                     raise
+                if self._supports_arena and self._arena_blocked:
+                    self._arena_blocked = False  # pages freed; edge re-arms
                 self._prof.end("serve/pack", t0,
                                trace_id=req.trace.trace_id
                                if req.trace is not None else None)
@@ -500,15 +548,44 @@ class ContinuousBatcher:
             req.future._resolve(res)
         self._set_active_gauge()
 
+    def _arena_backpressure(self, needed: int, free: int) -> None:
+        """Account one admit-blocked-on-pages event: count it, and dump
+        the flight ring on the RISING EDGE only (the first blocked tick
+        of a full-arena episode is the post-mortem moment — dumping on
+        every requeued retry would flood the ring dir with near-
+        identical dumps of the same episode)."""
+        self._c_arena_fail.inc()
+        if not self._arena_blocked:
+            self._arena_blocked = True  # tslint: disable=TS009 — single-writer dispatch-thread invariant (see _tick_evictions)
+            flightrec.trigger(self._reg, "arena_exhausted",
+                              needed=needed, free=free, tick=self._tick,
+                              prefilled=len(self._prefilled))
+        self._g_prefill_ready.set(len(self._prefilled))
+
+    def _observe_arena(self) -> None:
+        """Per-tick arena occupancy series (ISSUE 20): pages in use and
+        the fill fraction — host counters off the engine's arena
+        surface, no device sync."""
+        if not self._supports_arena:
+            return
+        stats = self._engine.arena_stats()
+        if not stats:
+            return
+        self._g_arena_pages.set(stats["in_use"])
+        self._h_arena_fill.observe(stats["fill"])
+
     def _record_frame(self, occupancy: float) -> None:
         """One flight-recorder frame per scheduler round (the serve-tick
         analogue of the trainer's per-step frame): what the engine was
         doing on the rounds BEFORE a failure trigger fires."""
+        extra = {}
+        if self._supports_arena:
+            extra["arena_free"] = self._engine.free_pages()
         flightrec.record(
             self._reg, "serve_tick", tick=self._tick,
             occupancy=round(occupancy, 4), queue_depth=self._q.qsize(),
             evictions=self._tick_evictions, refills=self._tick_refills,
-            prefilled=len(self._prefilled))
+            prefilled=len(self._prefilled), **extra)
 
     def tick(self, poll: float = 0.05) -> bool:
         """One scheduler round: evict -> refill -> step -> harvest.
@@ -535,6 +612,7 @@ class ContinuousBatcher:
         # contributes its own pre-failure frame (refill/evict state) and
         # the dump holds everything strictly preceding the trigger
         n_active = sum(r is not None for r in self._resident)
+        self._observe_arena()
         self._record_frame(n_active / self.slots)
         t0 = self._prof.start()
         with obs.spans.span(

@@ -151,6 +151,18 @@ DEFAULT: Dict[str, Any] = {
                 r"^HierarchicalSummarizer\.(_fan_out|_chunk_done"
                 r"|_record_chunk|_map_complete|_reduce_done)$",
                 r"^DocumentAssembler\.feed$",
+                # the paged resident state (ISSUE 20): page alloc/free
+                # run inside every admission/harvest on the dispatch
+                # thread, the engine's page accounting gates every
+                # refill, and the arena-occupancy observer fires every
+                # tick — pure-numpy by design; a device sync (or a
+                # blocking call) in any of them stalls every resident
+                # request's chunk cadence
+                r"^PageArena\.(alloc|free)$",
+                r"^SlotDecodeEngine\.(pages_needed|free_pages"
+                r"|arena_stats|_free_slot_pages)$",
+                r"^ContinuousBatcher\.(_arena_backpressure"
+                r"|_observe_arena)$",
             ],
             # the sanctioned sync windows (metrics flush batches one D2H
             # transfer per metrics_every steps by design)
